@@ -1,0 +1,16 @@
+"""diff3d_tpu — TPU-native (JAX/XLA/Flax/Pallas) framework with the
+capabilities of ``halixness/distributed-3d-diffusion-pytorch``: 3DiM-style
+pose-conditional X-UNet diffusion for novel view synthesis on SRN
+Cars/Chairs, with mesh-parallel training and stochastic-conditioning
+autoregressive sampling."""
+
+__version__ = "0.1.0"
+
+from diff3d_tpu.config import (Config, DataConfig, DiffusionConfig,
+                               MeshConfig, ModelConfig, TrainConfig,
+                               srn64_config, srn128_config, test_config)
+
+__all__ = [
+    "Config", "DataConfig", "DiffusionConfig", "MeshConfig", "ModelConfig",
+    "TrainConfig", "srn64_config", "srn128_config", "test_config",
+]

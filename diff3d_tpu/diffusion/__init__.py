@@ -1,0 +1,19 @@
+from diff3d_tpu.diffusion.core import (
+    alpha_sigma,
+    logsnr_schedule_cosine,
+    make_model_batch,
+    p_losses,
+    p_mean_variance,
+    q_sample,
+    sample_loop,
+)
+
+__all__ = [
+    "alpha_sigma",
+    "logsnr_schedule_cosine",
+    "make_model_batch",
+    "p_losses",
+    "p_mean_variance",
+    "q_sample",
+    "sample_loop",
+]

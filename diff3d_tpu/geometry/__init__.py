@@ -1,0 +1,4 @@
+from diff3d_tpu.geometry.posenc import posenc_ddpm, posenc_nerf
+from diff3d_tpu.geometry.rays import pinhole_rays
+
+__all__ = ["posenc_ddpm", "posenc_nerf", "pinhole_rays"]

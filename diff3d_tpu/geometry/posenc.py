@@ -1,0 +1,59 @@
+"""Positional encodings, pure jnp.
+
+Parity targets: ``posenc_ddpm`` (reference ``xunet.py:32-46``) and
+``posenc_nerf`` (reference ``xunet.py:49-59``).  Both are shape-polymorphic
+over leading dimensions here (the reference hardcodes the ``b f h w c``
+layout in an einops string).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def posenc_ddpm(timesteps: jnp.ndarray, emb_ch: int, max_time: float = 1000.0,
+                dtype=jnp.float32) -> jnp.ndarray:
+    """DDPM sinusoidal embedding of noise levels.
+
+    Matches reference ``xunet.py:32-46``: input scaled by ``1000/max_time``
+    (the model calls it with ``max_time=1.`` on raw logsnr values,
+    ``xunet.py:307``), frequencies ``exp(-arange(half) * ln(10000)/(half-1))``,
+    output ``concat([sin, cos], -1)`` of width ``emb_ch``.
+
+    Args:
+      timesteps: ``[...]`` float array.
+      emb_ch: embedding width (must be even).
+    Returns:
+      ``[..., emb_ch]``.
+    """
+    timesteps = jnp.asarray(timesteps, dtype) * (1000.0 / max_time)
+    half_dim = emb_ch // 2
+    freq = np.exp(np.arange(half_dim) * -(np.log(10000.0) / (half_dim - 1)))
+    emb = timesteps[..., None] * jnp.asarray(freq, dtype)
+    return jnp.concatenate([jnp.sin(emb), jnp.cos(emb)], axis=-1)
+
+
+def posenc_nerf(x: jnp.ndarray, min_deg: int = 0, max_deg: int = 15) -> jnp.ndarray:
+    """NeRF positional encoding, concatenated with the input.
+
+    Matches reference ``xunet.py:49-59``: ``xb[..., i, c] = x[..., c] * 2**i``
+    flattened scale-major, then ``sin(concat([xb, xb + pi/2]))`` appended to
+    ``x``.  Output channels: ``C + 2*C*(max_deg - min_deg)``.
+    """
+    if min_deg == max_deg:
+        return x
+    scales = jnp.asarray([2.0 ** i for i in range(min_deg, max_deg)], x.dtype)
+    # [..., D, C] -> [..., D*C] (scale-major, matching the reference's
+    # einops "(c d)" flatten where its `c` is the scale axis).
+    xb = x[..., None, :] * scales[:, None]
+    xb = xb.reshape(*x.shape[:-1], -1)
+    emb = jnp.sin(jnp.concatenate([xb, xb + jnp.pi / 2.0], axis=-1))
+    return jnp.concatenate([x, emb], axis=-1)
+
+
+def posenc_nerf_channels(min_deg: int, max_deg: int, base: int = 3) -> int:
+    """Output channel count of :func:`posenc_nerf` for a ``base``-dim input."""
+    if min_deg == max_deg:
+        return base
+    return base + 2 * base * (max_deg - min_deg)

@@ -1,0 +1,3 @@
+from diff3d_tpu.models.xunet import XUNet
+
+__all__ = ["XUNet"]

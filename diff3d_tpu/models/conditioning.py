@@ -1,0 +1,115 @@
+"""Pose/noise-level conditioning (reference ``xunet.py:259-352``), fully
+on-device.
+
+The reference drops to CPU numpy + visu3d for ray generation inside the hot
+forward (``xunet.py:311-314``); here rays come from
+:func:`diff3d_tpu.geometry.pinhole_rays` in pure jnp, so the whole
+conditioning path lives inside the jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from diff3d_tpu.geometry import pinhole_rays, posenc_ddpm, posenc_nerf
+from diff3d_tpu.geometry.posenc import posenc_nerf_channels
+
+# 93 (pos, degrees 0..15) + 51 (dir, degrees 0..8) = 144 channels,
+# reference xunet.py:317-320.
+POS_DEG = 15
+DIR_DEG = 8
+POSE_EMB_CH = posenc_nerf_channels(0, POS_DEG) + posenc_nerf_channels(0, DIR_DEG)
+
+
+class ConditioningProcessor(nn.Module):
+    """Produces ``(logsnr_emb [B,F,emb_ch], pose_embs[level])`` for the UNet.
+
+    Mechanism (parity with reference ``xunet.py:301-352``):
+      1. clip logsnr to the schedule bounds; DDPM-posenc it with
+         ``max_time=1.`` and MLP to ``emb_ch``.  (The reference's unused
+         ``lossnr`` arctan normalisation at ``xunet.py:306`` is dead code
+         and intentionally NOT reproduced.)
+      2. per-pixel rays from (R, t, K); NeRF-posenc pos (deg 15) and dir
+         (deg 8) -> 144 channels.
+      3. zero the pose embedding of BOTH frames where ``cond_mask`` is
+         False (classifier-free guidance, ``xunet.py:323-326``).
+      4. add learnable per-pixel ``pos_emb`` and per-frame first/other
+         embeddings (``xunet.py:281-290,333-337``).
+      5. strided 3x3 convs 144 -> emb_ch, stride ``2^level`` per UNet level.
+    """
+
+    emb_ch: int
+    H: int
+    W: int
+    num_resolutions: int
+    use_pos_emb: bool = True
+    use_ref_pose_emb: bool = True
+    logsnr_clip: float = 20.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, batch: dict, cond_mask: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
+        B = batch["x"].shape[0]
+        H, W = self.H, self.W
+        D = POSE_EMB_CH
+
+        logsnr = jnp.clip(batch["logsnr"], -self.logsnr_clip,
+                          self.logsnr_clip)                      # [B, F]
+        # Encodings stay float32: their sinusoid arguments reach ~2e4
+        # (posenc_ddpm's x1000 scaling) and 2^14 (NeRF degree 15), far past
+        # bf16's mantissa — bf16 here destroys all phase information.  The
+        # Dense/Conv layers below cast to the compute dtype themselves.
+        logsnr_emb = posenc_ddpm(logsnr, emb_ch=self.emb_ch, max_time=1.0,
+                                 dtype=jnp.float32)              # [B, F, emb_ch]
+        logsnr_emb = nn.Dense(self.emb_ch, dtype=self.dtype)(logsnr_emb)
+        logsnr_emb = nn.Dense(self.emb_ch, dtype=self.dtype)(
+            nn.silu(logsnr_emb))
+
+        # [B, F, H, W, 3] each; K broadcast over the frame axis
+        # (reference unsqueezes K at xunet.py:312).
+        pos, dirs = pinhole_rays(batch["R"].astype(jnp.float32),
+                                 batch["t"].astype(jnp.float32),
+                                 batch["K"][:, None].astype(jnp.float32),
+                                 H, W)
+        pose_emb = jnp.concatenate(
+            [posenc_nerf(pos, 0, POS_DEG), posenc_nerf(dirs, 0, DIR_DEG)],
+            axis=-1)                                             # [B, F, H, W, 144]
+
+        pose_emb = jnp.where(cond_mask[:, None, None, None, None], pose_emb,
+                             jnp.zeros_like(pose_emb))
+
+        if self.use_pos_emb:
+            pos_emb = self.param(
+                "pos_emb", nn.initializers.normal(1.0 / np.sqrt(D)),
+                (H, W, D))
+            pose_emb = pose_emb + pos_emb[None, None]
+        if self.use_ref_pose_emb:
+            first_emb = self.param(
+                "first_emb", nn.initializers.normal(1.0 / np.sqrt(D)),
+                (1, 1, 1, 1, D))
+            other_emb = self.param(
+                "other_emb", nn.initializers.normal(1.0 / np.sqrt(D)),
+                (1, 1, 1, 1, D))
+            # frame 0 = reference view, frames 1.. = others
+            # (reference concat at xunet.py:336 assumes F=2).
+            F = pose_emb.shape[1]
+            ref_emb = jnp.concatenate(
+                [first_emb] + [other_emb] * (F - 1), axis=1)
+            pose_emb = pose_emb + ref_emb
+
+        Bf, F = pose_emb.shape[:2]
+        flat = pose_emb.reshape(Bf * F, H, W, D)
+        pose_embs = []
+        for i_level in range(self.num_resolutions):
+            s = 2 ** i_level
+            lvl = nn.Conv(self.emb_ch, (3, 3), strides=(s, s),
+                          padding="SAME", dtype=self.dtype,
+                          name=f"level_conv_{i_level}")(flat)
+            pose_embs.append(lvl.reshape(Bf, F, H // s, W // s, self.emb_ch))
+
+        return logsnr_emb, pose_embs

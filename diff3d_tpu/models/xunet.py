@@ -1,0 +1,125 @@
+"""The X-UNet (Watson et al., 3DiM) as a Flax module.
+
+Parity target: reference ``/root/reference/xunet.py:355-536``.  One model
+definition replaces the reference's two variants (root + lightning, which
+differ only in device handling).  Differences by design, not omission:
+
+  * channels-last ``[B, F, H, W, C]`` layout (TPU-native; reference is NCHW);
+  * conditioning rays computed on-device (see
+    :mod:`diff3d_tpu.models.conditioning`);
+  * up-path input channel arithmetic (reference ``xunet.py:432-460``) is
+    implicit — Flax convs infer input width, and the skip push/pop structure
+    reproduces the same concatenations (asserted empty at the end, like
+    reference ``xunet.py:533``);
+  * optional bf16 compute and per-block rematerialisation for the 128^2
+    config that OOMs the reference's GPUs (README.md:39).
+
+Forward contract (reference ``xunet.py:477-536``): batch dict with
+``x [B,H,W,3]``, ``z [B,H,W,3]``, ``logsnr [B,2]``, ``R [B,2,3,3]``,
+``t [B,2,3]``, ``K [B,3,3]`` plus ``cond_mask [B] bool``; returns the
+predicted noise for the target frame, ``[B, H, W, 3]``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from diff3d_tpu.config import ModelConfig
+from diff3d_tpu.models.conditioning import ConditioningProcessor
+from diff3d_tpu.models.layers import FrameGroupNorm, ResnetBlock, XUNetBlock
+
+
+class XUNet(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, batch: dict, *, cond_mask: jnp.ndarray,
+                 deterministic: bool = True) -> jnp.ndarray:
+        cfg = self.cfg
+        cfg.validate()
+        dtype = jnp.dtype(cfg.dtype)
+        B, H, W, C = batch["x"].shape
+        assert (H, W) == (cfg.H, cfg.W), ((H, W), (cfg.H, cfg.W))
+        assert cond_mask.shape == (B,), (cond_mask.shape, B)
+
+        num_res = cfg.num_resolutions
+        dim_out = [cfg.ch * m for m in cfg.ch_mult]
+
+        if cfg.remat:
+            # argnums count `self` as 0, so `deterministic` is 3
+            block_cls = nn.remat(XUNetBlock, static_argnums=(3,))
+            resnet_cls = nn.remat(ResnetBlock, static_argnums=(3,))
+        else:
+            block_cls, resnet_cls = XUNetBlock, ResnetBlock
+
+        logsnr_emb, pose_embs = ConditioningProcessor(
+            emb_ch=cfg.emb_ch, H=H, W=W, num_resolutions=num_res,
+            use_pos_emb=cfg.use_pos_emb,
+            use_ref_pose_emb=cfg.use_ref_pose_emb, dtype=dtype,
+            name="conditioningprocessor")(batch, cond_mask)
+
+        def level_emb(i):
+            # [B, F, 1, 1, emb_ch] + [B, F, h, w, emb_ch]
+            return logsnr_emb[:, :, None, None, :] + pose_embs[i]
+
+        # Stem: both frames through one 3x3 conv (reference xunet.py:493-495).
+        h = jnp.stack([batch["x"], batch["z"]], axis=1).astype(dtype)
+        F = h.shape[1]
+        h = nn.Conv(cfg.ch, (3, 3), dtype=dtype,
+                    name="stem_conv")(h.reshape(B * F, H, W, C))
+        h = h.reshape(B, F, H, W, cfg.ch)
+
+        # Down path (reference xunet.py:498-512).
+        hs = [h]
+        for i_level in range(num_res):
+            emb = level_emb(i_level)
+            use_attn = i_level in cfg.attn_levels
+            for i_block in range(cfg.num_res_blocks):
+                h = block_cls(
+                    features=dim_out[i_level], use_attn=use_attn,
+                    num_heads=cfg.attn_heads, dropout=cfg.dropout,
+                    attn_impl=cfg.attn_impl, dtype=dtype,
+                    name=f"down_{i_level}_{i_block}")(h, emb, deterministic)
+                hs.append(h)
+            if i_level != num_res - 1:
+                h = resnet_cls(
+                    features=dim_out[i_level], dropout=cfg.dropout,
+                    resample="down", dtype=dtype,
+                    name=f"down_{i_level}_downsample")(h, emb, deterministic)
+                hs.append(h)
+
+        # Middle (reference xunet.py:419-424,515-517).
+        h = block_cls(
+            features=dim_out[-1], use_attn=num_res in cfg.attn_levels,
+            num_heads=cfg.attn_heads, dropout=cfg.dropout,
+            attn_impl=cfg.attn_impl, dtype=dtype,
+            name="middle")(h, level_emb(num_res - 1), deterministic)
+
+        # Up path (reference xunet.py:521-531): each block consumes
+        # concat([h, skip]) on the channel axis.
+        for i_level in reversed(range(num_res)):
+            emb = level_emb(i_level)
+            use_attn = i_level in cfg.attn_levels
+            for i_block in range(cfg.num_res_blocks + 1):
+                h = jnp.concatenate([h, hs.pop()], axis=-1)
+                h = block_cls(
+                    features=dim_out[i_level], use_attn=use_attn,
+                    num_heads=cfg.attn_heads, dropout=cfg.dropout,
+                    attn_impl=cfg.attn_impl, dtype=dtype,
+                    name=f"up_{i_level}_{i_block}")(h, emb, deterministic)
+            if i_level != 0:
+                h = resnet_cls(
+                    features=dim_out[i_level], dropout=cfg.dropout,
+                    resample="up", dtype=dtype,
+                    name=f"up_{i_level}_upsample")(h, emb, deterministic)
+        assert not hs
+
+        # Head: GN -> SiLU -> zero-init conv -> target frame's eps-hat
+        # (reference xunet.py:472-474,535-536).
+        h = nn.silu(FrameGroupNorm(dtype=dtype, name="last_gn")(h))
+        h = nn.Conv(3, (3, 3), dtype=dtype,
+                    kernel_init=nn.initializers.zeros,
+                    name="last_conv")(h.reshape(B * F, H, W, cfg.ch))
+        h = h.reshape(B, F, H, W, 3)
+        return h[:, 1].astype(jnp.float32)

@@ -1,0 +1,3 @@
+from diff3d_tpu.ops.attention import multi_head_attention
+
+__all__ = ["multi_head_attention"]

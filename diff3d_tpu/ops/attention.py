@@ -1,0 +1,57 @@
+"""Attention core with backend dispatch.
+
+The reference runs ``torch.nn.MultiheadAttention`` over ``H*W`` tokens
+(``/root/reference/xunet.py:154-177``) — 4096 tokens at 64^2, 16384 at
+128^2.  Here the softmax(QK^T)V core is a swappable backend:
+
+  * ``'xla'``    — ``jax.nn.dot_product_attention``; XLA already emits a
+    fused, flash-style kernel on TPU for moderate sequence lengths.
+  * ``'pallas'`` — hand-written TPU Pallas flash kernel
+    (:mod:`diff3d_tpu.ops.pallas_attention`), tiled for the MXU.
+  * ``'auto'``   — pallas on TPU when shapes qualify, else xla.
+
+All shapes here are ``[B, L, n_heads, head_dim]`` (jax.nn convention).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.cache
+def _default_backend() -> str:
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:  # no backend at trace time; be conservative
+        platform = "cpu"
+    return "pallas" if platform == "tpu" else "xla"
+
+
+def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+         impl: str = "auto") -> jnp.ndarray:
+    """Scaled dot-product attention over ``[B, L, H, D]`` tensors."""
+    if impl == "auto":
+        impl = _default_backend()
+    if impl == "pallas":
+        from diff3d_tpu.ops.pallas_attention import flash_attention, supports
+        if supports(q, k, v):
+            return flash_attention(q, k, v)
+        impl = "xla"
+    return jax.nn.dot_product_attention(q, k, v)
+
+
+def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         num_heads: int, impl: str = "auto") -> jnp.ndarray:
+    """Splits pre-projected ``[B, L, C]`` q/k/v into heads, runs sdpa,
+    merges heads back to ``[B, Lq, C]``.  Projections live in the Flax
+    layer (:class:`diff3d_tpu.models.layers.AttnLayer`)."""
+    B, Lq, C = q.shape
+    Lk = k.shape[1]
+    D = C // num_heads
+    out = sdpa(q.reshape(B, Lq, num_heads, D),
+               k.reshape(B, Lk, num_heads, D),
+               v.reshape(B, Lk, num_heads, D), impl=impl)
+    return out.reshape(B, Lq, C)

@@ -1,0 +1,19 @@
+"""TPU Pallas flash-attention kernel (stub pending; see ops/attention.py).
+
+Until the kernel lands, ``supports()`` returns False and the dispatcher
+falls back to ``jax.nn.dot_product_attention`` (which XLA fuses well on TPU
+for the model's 4096-16384 token sequences).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def supports(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> bool:
+    return False
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray,
+                    v: jnp.ndarray) -> jnp.ndarray:
+    raise NotImplementedError("pallas flash attention kernel pending")

@@ -1,0 +1,26 @@
+"""Test harness: force JAX onto CPU with 8 virtual devices, so
+multi-device mesh tests run anywhere (the TPU-world equivalent of a fake
+distributed backend — the reference has none, SURVEY.md §4).
+
+NOTE: in this image a sitecustomize imports jax at interpreter startup, so
+setting JAX_PLATFORMS in os.environ here is too late.  Instead we flip the
+already-imported config before any backend is initialised; XLA_FLAGS is
+also still honoured at that point because backends are created lazily.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh, got "
+    f"{jax.devices()[0].platform}")
+assert len(jax.devices()) == 8, len(jax.devices())

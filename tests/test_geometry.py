@@ -1,0 +1,92 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from diff3d_tpu.geometry import pinhole_rays, posenc_ddpm, posenc_nerf
+from diff3d_tpu.geometry.posenc import posenc_nerf_channels
+
+
+def test_posenc_ddpm_shape_and_values():
+    t = jnp.array([0.0, 10.0])
+    emb = posenc_ddpm(t, emb_ch=64, max_time=1.0)
+    assert emb.shape == (2, 64)
+    # t=0: sin part 0, cos part 1.
+    np.testing.assert_allclose(emb[0, :32], np.zeros(32), atol=1e-6)
+    np.testing.assert_allclose(emb[0, 32:], np.ones(32), atol=1e-6)
+    # first frequency is 1.0 -> emb[...,0] = sin(1000 * t)
+    np.testing.assert_allclose(emb[1, 0], np.sin(10.0 * 1000.0), rtol=1e-3)
+
+
+def test_posenc_ddpm_max_time_scaling():
+    t = jnp.array([500.0])
+    a = posenc_ddpm(t, 32, max_time=1000.0)
+    b = posenc_ddpm(jnp.array([0.5]), 32, max_time=1.0)
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_posenc_nerf_channels():
+    x = jnp.zeros((2, 2, 4, 4, 3))
+    assert posenc_nerf(x, 0, 15).shape[-1] == 93 == posenc_nerf_channels(0, 15)
+    assert posenc_nerf(x, 0, 8).shape[-1] == 51 == posenc_nerf_channels(0, 8)
+    assert posenc_nerf(x, 3, 3).shape[-1] == 3
+
+
+def test_posenc_nerf_values_scale_major():
+    # One pixel, x = (0.1, 0.2, 0.3): first 3 sin entries must be
+    # sin(2^0 * x) (scale-major flatten, reference einops "(c d)").
+    x = jnp.array([0.1, 0.2, 0.3])
+    out = np.asarray(posenc_nerf(x[None], 0, 2))[0]
+    assert out.shape == (3 + 2 * 3 * 2,)
+    np.testing.assert_allclose(out[:3], x, rtol=1e-6)
+    np.testing.assert_allclose(out[3:6], np.sin(x), rtol=1e-5)
+    np.testing.assert_allclose(out[6:9], np.sin(2 * np.asarray(x)), rtol=1e-5)
+    # the +pi/2 half is cosine
+    np.testing.assert_allclose(out[9:12], np.cos(x), rtol=1e-5)
+
+
+@pytest.fixture
+def simple_cam():
+    K = jnp.array([[100.0, 0.0, 32.0], [0.0, 100.0, 32.0], [0.0, 0.0, 1.0]])
+    R = jnp.eye(3)
+    t = jnp.array([1.0, 2.0, 3.0])
+    return R, t, K
+
+
+def test_pinhole_rays_identity_cam(simple_cam):
+    R, t, K = simple_cam
+    pos, dirs = pinhole_rays(R, t, K, 64, 64)
+    assert pos.shape == (64, 64, 3) and dirs.shape == (64, 64, 3)
+    # origins are the camera position everywhere
+    np.testing.assert_allclose(np.asarray(pos), np.broadcast_to(t, (64, 64, 3)))
+    # unit directions
+    np.testing.assert_allclose(np.linalg.norm(dirs, axis=-1), 1.0, rtol=1e-5)
+    # the pixel whose center hits the principal point looks along +z:
+    # u = j + 0.5 = cx = 32 -> j = 31.5 — not integral, so check the ray
+    # at pixel (31, 31): direction ((31.5-32)/100, (31.5-32)/100, 1)/norm
+    expect = np.array([-0.005, -0.005, 1.0])
+    expect /= np.linalg.norm(expect)
+    np.testing.assert_allclose(np.asarray(dirs[31, 31]), expect, atol=1e-5)
+
+
+def test_pinhole_rays_rotation(simple_cam):
+    R0, t, K = simple_cam
+    # 90-degree rotation about y: +z_cam -> +x_world
+    Ry = jnp.array([[0.0, 0.0, 1.0], [0.0, 1.0, 0.0], [-1.0, 0.0, 0.0]])
+    _, d0 = pinhole_rays(R0, t, K, 8, 8)
+    _, d1 = pinhole_rays(Ry, t, K, 8, 8)
+    np.testing.assert_allclose(
+        np.asarray(d1), np.einsum("ij,hwj->hwi", np.asarray(Ry),
+                                  np.asarray(d0)), atol=1e-5)
+
+
+def test_pinhole_rays_batched(simple_cam):
+    R, t, K = simple_cam
+    Rb = jnp.broadcast_to(R, (4, 2, 3, 3))
+    tb = jnp.broadcast_to(t, (4, 2, 3))
+    Kb = jnp.broadcast_to(K, (4, 1, 3, 3))
+    pos, dirs = pinhole_rays(Rb, tb, Kb, 16, 16)
+    assert pos.shape == (4, 2, 16, 16, 3)
+    assert dirs.shape == (4, 2, 16, 16, 3)
+    single = pinhole_rays(R, t, K, 16, 16)[1]
+    np.testing.assert_allclose(np.asarray(dirs[2, 1]), np.asarray(single),
+                               atol=1e-6)

@@ -1,0 +1,243 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from diff3d_tpu.config import ModelConfig
+from diff3d_tpu.models import XUNet
+from diff3d_tpu.models.layers import (AttnBlock, FiLM, FrameGroupNorm,
+                                      ResnetBlock, XUNetBlock,
+                                      avgpool_downsample,
+                                      nearest_neighbor_upsample)
+
+
+def tiny_cfg(**kw):
+    base = dict(H=16, W=16, ch=8, ch_mult=(1, 2, 2, 4), emb_ch=32,
+                num_res_blocks=1, attn_levels=(2, 3, 4), attn_heads=2,
+                dropout=0.0, dtype="float32")
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def make_batch(B, H, W, key=0):
+    rng = np.random.RandomState(key)
+    return {
+        "x": jnp.asarray(rng.randn(B, H, W, 3), jnp.float32),
+        "z": jnp.asarray(rng.randn(B, H, W, 3), jnp.float32),
+        "logsnr": jnp.asarray(np.stack([np.full(B, 20.0),
+                                        rng.uniform(-20, 20, B)], 1),
+                              jnp.float32),
+        "R": jnp.broadcast_to(jnp.eye(3), (B, 2, 3, 3)),
+        "t": jnp.asarray(rng.randn(B, 2, 3), jnp.float32),
+        "K": jnp.broadcast_to(
+            jnp.array([[20.0, 0, H / 2], [0, 20.0, H / 2], [0, 0, 1]]),
+            (B, 3, 3)),
+    }
+
+
+def test_resample_helpers():
+    h = jnp.arange(2 * 2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 2, 4, 4, 3)
+    up = nearest_neighbor_upsample(h)
+    assert up.shape == (2, 2, 8, 8, 3)
+    np.testing.assert_allclose(np.asarray(up[:, :, ::2, ::2]), np.asarray(h))
+    down = avgpool_downsample(h)
+    assert down.shape == (2, 2, 2, 2, 3)
+    np.testing.assert_allclose(float(down[0, 0, 0, 0, 0]),
+                               np.asarray(h[0, 0, :2, :2, 0]).mean())
+
+
+def test_groupnorm_normalizes_per_frame():
+    rng = jax.random.PRNGKey(0)
+    h = jax.random.normal(rng, (2, 2, 8, 8, 16)) * 5 + 3
+    gn = FrameGroupNorm()
+    out, _ = gn.init_with_output(rng, h)
+    # per (batch, frame) the output is ~standardised at init
+    m = np.asarray(out).reshape(4, -1)
+    np.testing.assert_allclose(m.mean(1), 0.0, atol=1e-4)
+    np.testing.assert_allclose(m.std(1), 1.0, atol=1e-2)
+
+
+def test_film_zero_emb_is_identity_at_init():
+    rng = jax.random.PRNGKey(0)
+    h = jax.random.normal(rng, (2, 2, 4, 4, 8))
+    emb = jnp.zeros((2, 2, 4, 4, 16))
+    film = FiLM(features=8)
+    out, _ = film.init_with_output(rng, h, emb)
+    # Dense bias is zero-init -> scale=shift=0 -> identity
+    np.testing.assert_allclose(np.asarray(out), np.asarray(h), atol=1e-6)
+
+
+@pytest.mark.parametrize("resample,expect_hw", [(None, 8), ("down", 4),
+                                                ("up", 16)])
+def test_resnet_block_shapes(resample, expect_hw):
+    rng = jax.random.PRNGKey(0)
+    h = jax.random.normal(rng, (2, 2, 8, 8, 8))
+    emb = jax.random.normal(rng, (2, 2, 8, 8, 16))
+    blk = ResnetBlock(features=12, resample=resample)
+    out, _ = blk.init_with_output(rng, h, emb)
+    assert out.shape == (2, 2, expect_hw, expect_hw, 12)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_resnet_block_zero_init_residual():
+    # At init conv2 is zero, so (pre-resample) output = (film_path + skip)/√2
+    # with identity channels -> for same-width block with zero emb the block
+    # output equals h_in/√2 exactly IF the first conv path contributed 0 to
+    # conv2's output (it does: conv2 weights are zero).
+    rng = jax.random.PRNGKey(0)
+    h = jax.random.normal(rng, (1, 2, 4, 4, 8))
+    emb = jnp.zeros((1, 2, 4, 4, 16))
+    blk = ResnetBlock(features=8)
+    out, _ = blk.init_with_output(rng, h, emb)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(h) / np.sqrt(2),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("attn_type", ["self", "cross"])
+def test_attn_block_residual_at_init(attn_type):
+    # zero-init out conv -> block is h/√2 at init
+    rng = jax.random.PRNGKey(0)
+    h = jax.random.normal(rng, (2, 2, 4, 4, 8))
+    blk = AttnBlock(attn_type, num_heads=2, attn_impl="xla")
+    out, _ = blk.init_with_output(rng, h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(h) / np.sqrt(2),
+                               atol=1e-5)
+
+
+def test_attn_cross_differs_from_self():
+    rng = jax.random.PRNGKey(0)
+    h = jax.random.normal(rng, (2, 2, 4, 4, 8))
+    out_s, vs = AttnBlock("self", 2, "xla").init_with_output(rng, h)
+    out_c, vc = AttnBlock("cross", 2, "xla").init_with_output(rng, h)
+    # same params (same rng/shape); different wiring must change activations
+    # of the attention layer itself (check pre-out-conv by perturbing):
+    # instead, simply run apply with a non-zero out conv.
+    params_s = jax.tree.map(lambda x: x + 0.1, vs["params"])
+    a = AttnBlock("self", 2, "xla").apply({"params": params_s}, h)
+    b = AttnBlock("cross", 2, "xla").apply({"params": params_s}, h)
+    assert np.abs(np.asarray(a) - np.asarray(b)).max() > 1e-6
+
+
+def test_xunet_forward_shape_and_param_structure():
+    cfg = tiny_cfg()
+    model = XUNet(cfg)
+    B = 2
+    batch = make_batch(B, cfg.H, cfg.W)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, batch, cond_mask=jnp.ones(B, bool))
+    out = model.apply(variables, batch, cond_mask=jnp.ones(B, bool))
+    assert out.shape == (B, cfg.H, cfg.W, 3)
+    assert np.isfinite(np.asarray(out)).all()
+    # zero-init head -> output is exactly zero at init
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_xunet_cond_mask_changes_output():
+    cfg = tiny_cfg()
+    model = XUNet(cfg)
+    B = 2
+    batch = make_batch(B, cfg.H, cfg.W)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, batch, cond_mask=jnp.ones(B, bool))
+    # nudge head conv away from zero so outputs are informative
+    params = jax.tree.map(lambda x: x + 0.01, variables["params"])
+    on = model.apply({"params": params}, batch,
+                     cond_mask=jnp.ones(B, bool))
+    off = model.apply({"params": params}, batch,
+                      cond_mask=jnp.zeros(B, bool))
+    assert np.abs(np.asarray(on) - np.asarray(off)).max() > 1e-6
+
+
+def test_xunet_jit_and_grad():
+    cfg = tiny_cfg()
+    model = XUNet(cfg)
+    B = 2
+    batch = make_batch(B, cfg.H, cfg.W)
+    variables = model.init(jax.random.PRNGKey(0), batch,
+                           cond_mask=jnp.ones(B, bool))
+
+    @jax.jit
+    def loss_fn(params):
+        out = model.apply({"params": params}, batch,
+                          cond_mask=jnp.ones(B, bool))
+        return jnp.mean(out ** 2)
+
+    g = jax.grad(loss_fn)(variables["params"])
+    leaves = jax.tree.leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    # some gradient must be nonzero (head is zero-init but loss pulls it)
+    total = sum(float(jnp.sum(jnp.abs(l))) for l in leaves)
+    assert total >= 0  # finite graph; head-zero means grads may be 0 at init
+
+
+def test_xunet_dropout_rng_path():
+    cfg = tiny_cfg(dropout=0.5)
+    model = XUNet(cfg)
+    B = 2
+    batch = make_batch(B, cfg.H, cfg.W)
+    variables = model.init(jax.random.PRNGKey(0), batch,
+                           cond_mask=jnp.ones(B, bool))
+    out = model.apply(variables, batch, cond_mask=jnp.ones(B, bool),
+                      deterministic=False,
+                      rngs={"dropout": jax.random.PRNGKey(1)})
+    assert out.shape == (B, cfg.H, cfg.W, 3)
+
+
+def test_xunet_remat_matches():
+    cfg = tiny_cfg()
+    cfg_r = tiny_cfg(remat=True)
+    B = 2
+    batch = make_batch(B, cfg.H, cfg.W)
+    v = XUNet(cfg).init(jax.random.PRNGKey(0), batch,
+                        cond_mask=jnp.ones(B, bool))
+    a = XUNet(cfg).apply(v, batch, cond_mask=jnp.ones(B, bool))
+    b = XUNet(cfg_r).apply(v, batch, cond_mask=jnp.ones(B, bool))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_xunet_rejects_bad_size():
+    with pytest.raises(ValueError):
+        XUNet(tiny_cfg(H=10)).init(
+            jax.random.PRNGKey(0), make_batch(1, 10, 16),
+            cond_mask=jnp.ones(1, bool))
+
+
+def test_xunet_remat_with_dropout_trains():
+    # regression: remat static_argnums must mark `deterministic` (argnum 3
+    # counting self) static, or dropout>0 under remat raises
+    # TracerBoolConversionError.
+    cfg = tiny_cfg(dropout=0.1, remat=True)
+    model = XUNet(cfg)
+    B = 1
+    batch = make_batch(B, cfg.H, cfg.W)
+    variables = model.init(jax.random.PRNGKey(0), batch,
+                           cond_mask=jnp.ones(B, bool))
+    out = model.apply(variables, batch, cond_mask=jnp.ones(B, bool),
+                      deterministic=False,
+                      rngs={"dropout": jax.random.PRNGKey(1)})
+    assert out.shape == (B, cfg.H, cfg.W, 3)
+
+
+def test_conditioning_encodings_stay_float32_in_bf16():
+    # regression: posenc sinusoid args reach ~2e4; computed in bf16 they
+    # lose all phase info (logsnr 4.0 vs 4.01 become identical).
+    from diff3d_tpu.models.conditioning import ConditioningProcessor
+    cp = ConditioningProcessor(emb_ch=32, H=8, W=8, num_resolutions=2,
+                               dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+
+    def batch_with_logsnr(v):
+        return {
+            "x": jnp.zeros((1, 8, 8, 3)),
+            "logsnr": jnp.array([[20.0, v]]),
+            "R": jnp.broadcast_to(jnp.eye(3), (1, 2, 3, 3)),
+            "t": jnp.asarray(rng.randn(1, 2, 3), jnp.float32),
+            "K": jnp.broadcast_to(jnp.eye(3), (1, 3, 3)),
+        }
+
+    b1 = batch_with_logsnr(4.0)
+    variables = cp.init(jax.random.PRNGKey(0), b1, jnp.ones(1, bool))
+    e1, _ = cp.apply(variables, b1, jnp.ones(1, bool))
+    e2, _ = cp.apply(variables, batch_with_logsnr(4.01), jnp.ones(1, bool))
+    assert np.abs(np.asarray(e1, np.float32)
+                  - np.asarray(e2, np.float32)).max() > 1e-3
