@@ -36,6 +36,9 @@ class ModelConfig:
     dropout: float = 0.1
     use_pos_emb: bool = True
     use_ref_pose_emb: bool = True
+    # Noise-level embedding clip bound; keep equal to
+    # DiffusionConfig.logsnr_max (reference hardcodes 20, xunet.py:305).
+    logsnr_clip: float = 20.0
     # TPU-first additions (no reference counterpart):
     dtype: str = "bfloat16"        # compute dtype; params stay float32
     remat: bool = False            # jax.checkpoint each UNet block

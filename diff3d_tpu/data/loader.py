@@ -1,0 +1,122 @@
+"""Infinite, per-host-sharded batch loader with device prefetch.
+
+Replaces the reference's ``MultiEpochsDataLoader`` + ``_RepeatSampler``
+(``SRNdataset.py:12-40``, persistent workers that yield forever) and its
+broken ``DistributedSampler`` usage (``train.py:224-226``, see SURVEY.md
+§2.7).  TPU-native design:
+
+  * each host draws its own disjoint slice of the global batch, derived
+    deterministically from ``(seed, step, host_id)`` — no sampler state to
+    synchronise and resume is exact: seek to any step by number;
+  * a thread pool overlaps image decode with device compute;
+  * :func:`prefetch_to_device` keeps ``depth`` batches in flight as sharded
+    device arrays (the JAX equivalent of pinned-memory prefetch).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+def _collate(samples) -> Dict[str, np.ndarray]:
+    return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+
+
+class InfiniteLoader:
+    """Yields ``{'imgs':[B,V,H,W,3], 'R':[B,V,3,3], 'T':[B,V,3], 'K':[B,3,3]}``
+    forever, ``B`` = per-host batch size.
+
+    Sampling is stateless-per-step: batch ``n`` on host ``h`` is a pure
+    function of ``(seed, n, h)``, so checkpoint resume replays the exact
+    data order without any loader state (the reference's resume restores
+    only the step counter, ``train.py:244-251``).
+    """
+
+    def __init__(self, dataset, batch_size: int, *, seed: int = 0,
+                 host_id: int = 0, num_hosts: int = 1,
+                 num_workers: int = 8, start_step: int = 0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.seed = seed
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self._step = start_step
+        self._pool = (ThreadPoolExecutor(num_workers)
+                      if num_workers > 0 else None)
+
+    def _batch(self, step: int) -> Dict[str, np.ndarray]:
+        root = np.random.SeedSequence(
+            entropy=self.seed, spawn_key=(step, self.host_id))
+        seqs = root.spawn(self.batch_size)
+        n = len(self.dataset)
+
+        def one(seq):
+            rng = np.random.default_rng(seq)
+            return self.dataset.sample(int(rng.integers(n)), rng)
+
+        if self._pool is not None:
+            samples = list(self._pool.map(one, seqs))
+        else:
+            samples = [one(s) for s in seqs]
+        return _collate(samples)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        batch = self._batch(self._step)
+        self._step += 1
+        return batch
+
+
+def prefetch_to_device(it: Iterator, sharding=None, depth: int = 2,
+                       to_device: bool = True) -> Iterator:
+    """Runs ``it`` in a background thread, keeping ``depth`` batches ahead;
+    each batch is ``jax.device_put`` with ``sharding`` (a NamedSharding with
+    the batch axis on the mesh's data axis) so the global array lands
+    already sharded."""
+    import jax
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _SENTINEL = object()
+
+    def producer():
+        try:
+            for batch in it:
+                if stop.is_set():
+                    return
+                if to_device:
+                    batch = jax.tree.map(
+                        lambda x: jax.device_put(x, sharding), batch)
+                q.put(batch)
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+
+    class _Prefetcher:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            item = q.get()
+            if item is _SENTINEL:
+                raise StopIteration
+            return item
+
+        def close(self):
+            stop.set()
+            while True:  # drain so the producer can observe `stop`
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+    return _Prefetcher()

@@ -1,0 +1,154 @@
+"""SRN Cars/Chairs dataset (ShapeNet renders), TPU-native layout.
+
+Capability parity with the reference's ``SRNdataset.py:42-95``:
+
+  * an index maps object-id -> list of view png filenames.  The reference
+    ships this as ``data/{cars,chairs}.pickle``; :func:`build_index`
+    regenerates it by globbing ``<path>/<obj>/rgb/*.png`` when the pickle is
+    absent (the repo's pickles are stripped from the mount,
+    ``.MISSING_LARGE_BLOBS``), and loads/saves the same pickle format.
+  * deterministic 90/10 train/val split: ``random.seed(0)`` + shuffle of the
+    sorted ids (``SRNdataset.py:50-57``).
+  * a sample is 2 random views of one object: image resized to ``imgsize``,
+    scaled to [-1, 1], first 3 channels; pose ``4x4`` txt -> ``R [3,3]``,
+    ``T [3]``; one shared ``3x3`` intrinsics K read from the first view's
+    txt (``SRNdataset.py:64-93``).
+
+Differences by design: images are **NHWC** float32 (TPU-native; reference is
+CHW), and sampling takes an explicit ``numpy.random.Generator`` so the
+pipeline is reproducible and per-host shardable (the reference uses the
+global ``random`` module).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import random
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+try:  # PIL ships with the image; gate anyway so array-only use works.
+    from PIL import Image
+    _HAVE_PIL = True
+except ImportError:  # pragma: no cover
+    _HAVE_PIL = False
+
+
+def build_index(path: str, picklefile: str | None = None,
+                save: bool = False) -> Dict[str, List[str]]:
+    """Load or regenerate the object-id -> view-filename index.
+
+    If ``picklefile`` exists it is loaded (reference format: dict of id ->
+    list of png basenames, ``SRNdataset.py:48``).  Otherwise the index is
+    rebuilt by globbing ``<path>/<obj>/rgb/*.png`` and optionally saved back
+    to ``picklefile``.
+    """
+    if picklefile and os.path.exists(picklefile):
+        with open(picklefile, "rb") as f:
+            return pickle.load(f)
+    index: Dict[str, List[str]] = {}
+    for obj in sorted(os.listdir(path)):
+        rgb = os.path.join(path, obj, "rgb")
+        if not os.path.isdir(rgb):
+            continue
+        views = sorted(f for f in os.listdir(rgb) if f.endswith(".png"))
+        if views:
+            index[obj] = views
+    if not index:
+        raise FileNotFoundError(f"no SRN objects under {path}")
+    if save and picklefile:
+        os.makedirs(os.path.dirname(picklefile) or ".", exist_ok=True)
+        with open(picklefile, "wb") as f:
+            pickle.dump(index, f)
+    return index
+
+
+def split_ids(ids: Sequence[str], split: str, seed: int = 0,
+              train_fraction: float = 0.9) -> List[str]:
+    """Reference split semantics (``SRNdataset.py:50-57``): seed the stdlib
+    RNG, shuffle the sorted ids, first 90% train / rest val."""
+    allthevid = sorted(ids)
+    rng = random.Random(seed)
+    rng.shuffle(allthevid)
+    cut = int(len(allthevid) * train_fraction)
+    return allthevid[:cut] if split == "train" else allthevid[cut:]
+
+
+def load_pose(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """``pose/<view>.txt`` holds a flat 4x4 world-from-camera matrix;
+    returns ``(R [3,3], T [3])`` (``SRNdataset.py:86-93``)."""
+    mat = np.loadtxt(path).reshape(4, 4)
+    return mat[:3, :3], mat[:3, 3]
+
+
+def load_intrinsics(path: str) -> np.ndarray:
+    """``intrinsics/<view>.txt`` holds a flat 3x3 K (``SRNdataset.py:68-69``)."""
+    return np.loadtxt(path).reshape(3, 3)
+
+
+class SRNDataset:
+    """Map-style two-view dataset over SRN objects.
+
+    ``sample(idx, rng)`` returns a dict with ``imgs [2, s, s, 3] f32`` in
+    [-1, 1] NHWC, ``R [2, 3, 3] f32``, ``T [2, 3] f32``, ``K [3, 3] f32``.
+    """
+
+    def __init__(self, split: str, path: str, picklefile: str | None = None,
+                 imgsize: int = 64, split_seed: int = 0,
+                 train_fraction: float = 0.9, num_views: int = 2):
+        if not _HAVE_PIL:
+            raise RuntimeError("PIL required for SRNDataset image loading")
+        self.path = path
+        self.imgsize = imgsize
+        self.num_views = num_views
+        self.index = build_index(path, picklefile)
+        self.ids = split_ids(list(self.index.keys()), split, split_seed,
+                             train_fraction)
+        if not self.ids:
+            raise ValueError(f"empty split {split!r}")
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def _load_view(self, obj: str, view: str) -> Tuple[np.ndarray, np.ndarray,
+                                                       np.ndarray]:
+        img = Image.open(os.path.join(self.path, obj, "rgb", view))
+        if img.size != (self.imgsize, self.imgsize):
+            img = img.resize((self.imgsize, self.imgsize))
+        arr = np.asarray(img, np.float32) / 255.0 * 2.0 - 1.0
+        if arr.ndim == 2:
+            arr = np.repeat(arr[..., None], 3, axis=-1)
+        arr = arr[..., :3]                       # drop alpha, keep NHWC
+        R, T = load_pose(
+            os.path.join(self.path, obj, "pose", view[:-4] + ".txt"))
+        return arr, R.astype(np.float32), T.astype(np.float32)
+
+    def sample(self, idx: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        obj = self.ids[idx]
+        views = self.index[obj]
+        chosen = rng.choice(len(views), size=self.num_views, replace=False)
+        imgs, Rs, Ts = zip(*(self._load_view(obj, views[i]) for i in chosen))
+        K = load_intrinsics(os.path.join(
+            self.path, obj, "intrinsics", views[0][:-4] + ".txt"))
+        return {
+            "imgs": np.stack(imgs).astype(np.float32),
+            "R": np.stack(Rs),
+            "T": np.stack(Ts),
+            "K": K.astype(np.float32),
+        }
+
+    def all_views(self, obj: str) -> Dict[str, np.ndarray]:
+        """Every view of one object, for the sampler's autoregressive loop
+        (reference ``sampling.py:26-48`` loads the whole target dir)."""
+        views = self.index[obj]
+        imgs, Rs, Ts = zip(*(self._load_view(obj, v) for v in views))
+        K = load_intrinsics(os.path.join(
+            self.path, obj, "intrinsics", views[0][:-4] + ".txt"))
+        return {
+            "imgs": np.stack(imgs).astype(np.float32),
+            "R": np.stack(Rs),
+            "T": np.stack(Ts),
+            "K": K.astype(np.float32),
+        }

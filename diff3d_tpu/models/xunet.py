@@ -56,7 +56,8 @@ class XUNet(nn.Module):
         logsnr_emb, pose_embs = ConditioningProcessor(
             emb_ch=cfg.emb_ch, H=H, W=W, num_resolutions=num_res,
             use_pos_emb=cfg.use_pos_emb,
-            use_ref_pose_emb=cfg.use_ref_pose_emb, dtype=dtype,
+            use_ref_pose_emb=cfg.use_ref_pose_emb,
+            logsnr_clip=cfg.logsnr_clip, dtype=dtype,
             name="conditioningprocessor")(batch, cond_mask)
 
         def level_emb(i):
@@ -120,6 +121,6 @@ class XUNet(nn.Module):
         h = nn.silu(FrameGroupNorm(dtype=dtype, name="last_gn")(h))
         h = nn.Conv(3, (3, 3), dtype=dtype,
                     kernel_init=nn.initializers.zeros,
-                    name="last_conv")(h.reshape(B * F, H, W, cfg.ch))
+                    name="last_conv")(h.reshape(B * F, H, W, dim_out[0]))
         h = h.reshape(B, F, H, W, 3)
         return h[:, 1].astype(jnp.float32)

@@ -1,0 +1,8 @@
+from diff3d_tpu.parallel.mesh import (MeshEnv, batch_sharding, make_mesh,
+                                      param_sharding, replicated_sharding)
+from diff3d_tpu.parallel.multihost import maybe_initialize_distributed
+
+__all__ = [
+    "MeshEnv", "make_mesh", "batch_sharding", "param_sharding",
+    "replicated_sharding", "maybe_initialize_distributed",
+]

@@ -1,0 +1,109 @@
+"""Device mesh + sharding-spec layer.
+
+The reference's distributed surface is ``torch.distributed`` DDP over gloo
+(``/root/reference/train.py:187,224-233`` — broken as shipped, SURVEY.md
+§2.7) plus per-step ``dist.barrier()`` calls.  The TPU-native equivalent:
+one ``jax.sharding.Mesh`` over ``(data, model)`` axes; ``jit`` with
+``NamedSharding`` in/out specs compiles the gradient all-reduce into XLA
+collectives that ride ICI within a slice and DCN across slices.  No
+user-level barriers exist because every compiled step is globally
+synchronous by construction.
+
+Param placement is a config switch (``MeshConfig.param_sharding``):
+
+  * ``'replicated'`` — DDP-like; params/opt-state replicated, gradients
+    all-reduced (what the reference intends).
+  * ``'fsdp'``       — ZeRO-style; each param's largest divisible axis is
+    sharded over the data axis, all-gathered on use.
+
+The ``model`` axis is reserved for tensor parallelism — not needed for
+reference parity (SURVEY.md §2.8: the reference has DP only) but a config
+change, not a rewrite, when models outgrow a chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from diff3d_tpu.config import MeshConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshEnv:
+    """A mesh plus the sharding rules derived from config."""
+
+    mesh: Mesh
+    cfg: MeshConfig
+
+    @property
+    def data_axis(self) -> str:
+        return self.cfg.data_axis
+
+    def batch(self) -> NamedSharding:
+        return batch_sharding(self.mesh, self.cfg.data_axis)
+
+    def replicated(self) -> NamedSharding:
+        return replicated_sharding(self.mesh)
+
+    def params(self, pytree) -> object:
+        """Sharding pytree for params/opt-state per the config policy."""
+        if self.cfg.param_sharding == "replicated":
+            return jax.tree.map(lambda _: self.replicated(), pytree)
+        if self.cfg.param_sharding == "fsdp":
+            return jax.tree.map(
+                lambda x: param_sharding(self.mesh, np.shape(x),
+                                         self.cfg.data_axis), pytree)
+        raise ValueError(self.cfg.param_sharding)
+
+
+def make_mesh(cfg: MeshConfig = MeshConfig(),
+              devices: Optional[Sequence[jax.Device]] = None) -> MeshEnv:
+    """Build a ``(data, model)`` mesh over all (or given) devices.
+
+    ``data_parallel == -1`` takes every device not claimed by
+    ``model_parallel``.  Device order follows ``jax.devices()``, which
+    groups hosts contiguously — so the data axis splits across hosts (DCN)
+    only after filling each host's chips (ICI), the layout the scaling
+    playbook prescribes for pure DP.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    mp = max(1, cfg.model_parallel)
+    dp = cfg.data_parallel
+    if dp == -1:
+        dp = len(devices) // mp
+    if dp * mp > len(devices):
+        raise ValueError(
+            f"mesh {dp}x{mp} needs {dp * mp} devices, have {len(devices)}")
+    grid = np.asarray(devices[: dp * mp]).reshape(dp, mp)
+    mesh = Mesh(grid, (cfg.data_axis, cfg.model_axis))
+    return MeshEnv(mesh=mesh, cfg=cfg)
+
+
+def batch_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:
+    """Leading (batch) dim over the data axis, rest replicated."""
+    return NamedSharding(mesh, P(data_axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_sharding(mesh: Mesh, shape: Sequence[int],
+                   data_axis: str = "data") -> NamedSharding:
+    """FSDP-style spec: shard the largest axis divisible by the data-axis
+    size; replicate params too small to bother (< one tile per device)."""
+    n = mesh.shape[data_axis]
+    if n == 1 or not shape or int(np.prod(shape)) < n * 128:
+        return NamedSharding(mesh, P())
+    candidates = [i for i, s in enumerate(shape) if s % n == 0]
+    if not candidates:
+        return NamedSharding(mesh, P())
+    axis = max(candidates, key=lambda i: shape[i])
+    spec = [None] * len(shape)
+    spec[axis] = data_axis
+    return NamedSharding(mesh, P(*spec))

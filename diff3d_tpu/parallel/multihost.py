@@ -1,0 +1,51 @@
+"""Multi-host bring-up.
+
+The reference hardcodes ``MASTER_ADDR=localhost`` and spawns one process
+per GPU with gloo TCP rendezvous (``/root/reference/train.py:181-187``) —
+single-node only.  On TPU pods, ``jax.distributed.initialize()`` picks up
+the coordinator from the TPU runtime environment automatically; after it,
+``jax.devices()`` spans every host and the mesh layer (``mesh.py``) scales
+unchanged from 1 chip to a full pod.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def maybe_initialize_distributed(coordinator_address: str | None = None,
+                                 num_processes: int | None = None,
+                                 process_id: int | None = None) -> bool:
+    """Initialise JAX's multi-host runtime if we're in a multi-process job.
+
+    Safe to call unconditionally: single-process (one host, N local chips)
+    skips initialisation, and a second call is a no-op.  Returns True when
+    the distributed client is live.
+    """
+    if jax.process_count() > 1:
+        return True  # already initialised (e.g. by the launcher)
+    explicit = coordinator_address is not None
+    if not explicit and jax.default_backend() != "tpu":
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        log.info("jax.distributed up: process %d/%d, %d global devices",
+                 jax.process_index(), jax.process_count(),
+                 jax.device_count())
+        return True
+    except (RuntimeError, ValueError) as e:
+        # Single-host TPU (no coordinator env) lands here; that's fine.
+        log.debug("jax.distributed.initialize skipped: %s", e)
+        return False
+
+
+def is_primary() -> bool:
+    """True on the process that owns checkpoint/metric writes (the
+    reference gates these on rank 0, ``train.py:287-298``)."""
+    return jax.process_index() == 0

@@ -1,0 +1,71 @@
+"""Train state: params + Adam state + EMA, one pytree.
+
+Reference counterparts: ``Adam(lr=1e-4, betas=(0.9, 0.99))``
+(``/root/reference/train.py:235``), linear lr warmup
+(``train.py:169-177``, intended over the first 10M examples per the paper
+config quoted at ``lightning/diff3d.py:11-20``), and the EMA with 500K-
+example half-life that the reference *documents but never implements*
+(``lightning/diff3d.py:19-20``; SURVEY.md §2.3) — implemented here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from diff3d_tpu.config import TrainConfig
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jnp.ndarray            # scalar int32
+    params: Any
+    opt_state: Any
+    ema_params: Any
+
+
+def warmup_schedule(cfg: TrainConfig) -> optax.Schedule:
+    """Linear warmup to ``cfg.lr`` over ``warmup_examples`` examples
+    (= ``warmup_examples / global_batch`` steps), then constant.
+
+    Matches the reference's ``(step+1)/last_step`` ramp
+    (``train.py:172-175``) so step 0 already takes a non-zero lr.  (The
+    reference's raw-DDP path computes ``last_step = num_epochs /
+    batch_size`` by mistake, disabling warmup — ``train.py:267``, SURVEY.md
+    §2.7; this implements the documented 10M-example intent.)"""
+    warmup_steps = max(1, cfg.warmup_examples // cfg.global_batch)
+
+    def schedule(step):
+        frac = jnp.clip((step + 1.0) / warmup_steps, 0.0, 1.0)
+        return cfg.lr * frac
+
+    return schedule
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    tx = optax.adam(learning_rate=warmup_schedule(cfg),
+                    b1=cfg.betas[0], b2=cfg.betas[1])
+    if cfg.grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+    return tx
+
+
+def ema_decay_per_step(cfg: TrainConfig) -> float:
+    """Per-step decay for an EMA with half-life ``ema_halflife_examples``:
+    ``0.5 ** (global_batch / halflife)``."""
+    if cfg.ema_halflife_examples <= 0:
+        return 0.0
+    return float(0.5 ** (cfg.global_batch / cfg.ema_halflife_examples))
+
+
+def create_train_state(params, cfg: TrainConfig) -> TrainState:
+    tx = make_optimizer(cfg)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        ema_params=jax.tree.map(jnp.copy, params),
+    )

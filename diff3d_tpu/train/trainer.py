@@ -1,0 +1,142 @@
+"""Trainer: wires data, mesh, compiled step, checkpoints, and metrics.
+
+Capability parity with both reference trainers (raw-DDP ``train.py:200-303``
+and Lightning ``lightning/train.py`` + ``lightning/diff3d.py:77-127``),
+minus their defects (SURVEY.md §2.7): the data path is correctly sharded
+per host, gradients actually all-reduce (compiled from shardings), warmup
+follows the documented 10M-example intent, checkpoints never reference
+undefined state, and there are no per-step host barriers.
+
+Observability the reference lacks: JSONL metrics (loss / lr / grad-norm /
+steps-per-sec / examples-per-sec), optional ``jax.profiler`` traces.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from diff3d_tpu.config import Config
+from diff3d_tpu.models import XUNet
+from diff3d_tpu.parallel import MeshEnv, make_mesh
+from diff3d_tpu.parallel.multihost import is_primary
+from diff3d_tpu.train.checkpoint import CheckpointManager
+from diff3d_tpu.train.state import TrainState, create_train_state
+from diff3d_tpu.train.step import make_train_step
+
+log = logging.getLogger(__name__)
+
+
+def init_params(model: XUNet, cfg: Config, rng: jax.Array):
+    """Initialise params with a dummy batch (shapes only)."""
+    H, W = cfg.model.H, cfg.model.W
+    batch = {
+        "x": jnp.zeros((1, H, W, 3)),
+        "z": jnp.zeros((1, H, W, 3)),
+        "logsnr": jnp.zeros((1, 2)),
+        "R": jnp.broadcast_to(jnp.eye(3), (1, 2, 3, 3)),
+        "t": jnp.zeros((1, 2, 3)),
+        "K": jnp.broadcast_to(jnp.eye(3), (1, 3, 3)),
+    }
+    return model.init({"params": rng}, batch,
+                      cond_mask=jnp.ones((1,), bool))["params"]
+
+
+class Trainer:
+    def __init__(self, cfg: Config, loader: Iterator,
+                 env: Optional[MeshEnv] = None,
+                 workdir: str = ".", transfer: bool = False):
+        self.cfg = cfg
+        self.loader = loader
+        self.env = env or make_mesh(cfg.mesh)
+        self.workdir = workdir
+        self.model = XUNet(cfg.model)
+        self.rng = jax.random.PRNGKey(cfg.train.seed)
+
+        params = init_params(self.model, cfg, self.rng)
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree.leaves(params))
+        log.info("XUNet: %.1fM params", n_params / 1e6)
+        state = create_train_state(params, cfg.train)
+
+        # Place the fresh state according to the mesh policy before any
+        # compile, so fsdp never materialises a replicated copy.
+        self.state = jax.device_put(state, self._state_shardings(state))
+
+        self.ckpt = CheckpointManager(
+            os.path.join(workdir, cfg.train.checkpoint_dir),
+            keep=cfg.train.keep_checkpoints)
+        if transfer:
+            restored = self.ckpt.restore(self._abstract_state())
+            if restored is not None:
+                self.state = restored
+                log.info("resumed at step %d", int(self.state.step))
+
+        self.step_fn = make_train_step(self.model, cfg, self.env)
+        self._metrics_path = os.path.join(workdir, "metrics.jsonl")
+
+    def _state_shardings(self, state: TrainState) -> TrainState:
+        return TrainState(step=self.env.replicated(),
+                          params=self.env.params(state.params),
+                          opt_state=self.env.params(state.opt_state),
+                          ema_params=self.env.params(state.ema_params))
+
+    def _abstract_state(self) -> TrainState:
+        abstract = jax.eval_shape(
+            lambda s: s, jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.state))
+        sh = self._state_shardings(abstract)
+        return jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract, sh)
+
+    def _log(self, record: dict) -> None:
+        if not is_primary():
+            return
+        with open(self._metrics_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def train(self, max_steps: Optional[int] = None) -> TrainState:
+        cfg = self.cfg.train
+        max_steps = max_steps if max_steps is not None else cfg.max_steps
+        t0 = time.monotonic()
+        window_start, window_t = int(self.state.step), t0
+
+        while int(self.state.step) < max_steps:
+            batch = next(self.loader)
+            batch = {"imgs": batch["imgs"], "R": batch["R"],
+                     "T": batch["T"], "K": batch["K"]}
+            self.state, metrics = self.step_fn(self.state, batch, self.rng)
+            step = int(self.state.step)
+
+            if step % cfg.log_every == 0 or step >= max_steps:
+                jax.block_until_ready(metrics["loss"])
+                now = time.monotonic()
+                dt = max(now - window_t, 1e-9)
+                sps = (step - window_start) / dt
+                window_start, window_t = step, now
+                rec = {
+                    "step": step,
+                    "loss": float(metrics["loss"]),
+                    "lr": float(metrics["lr"]),
+                    "grad_norm": float(metrics["grad_norm"]),
+                    "steps_per_sec": sps,
+                    "examples_per_sec": sps * cfg.global_batch,
+                    "wall_s": now - t0,
+                }
+                self._log(rec)
+                log.info("step %d loss %.4f (%.2f steps/s)",
+                         step, rec["loss"], sps)
+
+            if step % cfg.ckpt_every == 0 or step >= max_steps:
+                self.ckpt.save(self.state)
+
+        self.ckpt.wait()
+        return self.state

@@ -162,12 +162,14 @@ def test_xunet_jit_and_grad():
                           cond_mask=jnp.ones(B, bool))
         return jnp.mean(out ** 2)
 
-    g = jax.grad(loss_fn)(variables["params"])
+    # Nudge the zero-init head so the loss has a live gradient path.
+    params = variables["params"]
+    params = jax.tree.map(lambda x: x + 0.01, params)
+    g = jax.grad(loss_fn)(params)
     leaves = jax.tree.leaves(g)
     assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
-    # some gradient must be nonzero (head is zero-init but loss pulls it)
     total = sum(float(jnp.sum(jnp.abs(l))) for l in leaves)
-    assert total >= 0  # finite graph; head-zero means grads may be 0 at init
+    assert total > 0
 
 
 def test_xunet_dropout_rng_path():

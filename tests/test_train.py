@@ -1,0 +1,174 @@
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from diff3d_tpu.config import MeshConfig, TrainConfig
+from diff3d_tpu.config import test_config as make_tiny_config
+from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
+from diff3d_tpu.models import XUNet
+from diff3d_tpu.parallel import make_mesh
+from diff3d_tpu.train import (CheckpointManager, TrainState, Trainer,
+                              create_train_state, ema_decay_per_step,
+                              make_train_step, warmup_schedule)
+from diff3d_tpu.train.trainer import init_params
+
+
+def tiny_cfg(**train_kw):
+    cfg = make_tiny_config(imgsize=8, ch=8)
+    if train_kw:
+        import dataclasses
+        cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, **train_kw))
+    return cfg
+
+
+def make_batch(cfg, B=8, seed=0):
+    ds = SyntheticDataset(num_objects=2, num_views=4,
+                          imgsize=cfg.model.H, seed=seed)
+    b = next(InfiniteLoader(ds, B, seed=seed, num_workers=0))
+    return {"imgs": jnp.asarray(b["imgs"]), "R": jnp.asarray(b["R"]),
+            "T": jnp.asarray(b["T"]), "K": jnp.asarray(b["K"])}
+
+
+def test_warmup_schedule_linear_then_flat():
+    cfg = TrainConfig(lr=1e-4, warmup_examples=1000, global_batch=100)
+    sched = warmup_schedule(cfg)  # 10 warmup steps, (step+1)/10 ramp
+    np.testing.assert_allclose(float(sched(0)), 1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(sched(4)), 5e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(sched(9)), 1e-4, rtol=1e-5)
+    np.testing.assert_allclose(float(sched(1000)), 1e-4, rtol=1e-5)
+
+
+def test_ema_decay_halflife():
+    cfg = TrainConfig(global_batch=128, ema_halflife_examples=500_000)
+    d = ema_decay_per_step(cfg)
+    halflife_steps = 500_000 / 128
+    np.testing.assert_allclose(d ** halflife_steps, 0.5, rtol=1e-6)
+
+
+def test_train_step_runs_and_loss_decreases():
+    cfg = tiny_cfg()
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    params = init_params(model, cfg, rng)
+    state = create_train_state(params, cfg.train)
+    step_fn = make_train_step(model, cfg, env=None)
+    batch = make_batch(cfg)
+
+    first = None
+    for _ in range(30):
+        state, metrics = step_fn(state, batch, rng)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert np.isfinite(last)
+    assert last < first, (first, last)
+    assert int(state.step) == 30
+
+
+def test_train_step_updates_ema_toward_params():
+    cfg = tiny_cfg()
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(init_params(model, cfg, rng), cfg.train)
+    step_fn = make_train_step(model, cfg, env=None)
+    batch = make_batch(cfg)
+    state2, _ = step_fn(state, batch, rng)
+    # EMA moved but is not equal to the new params
+    diffs = jax.tree.map(
+        lambda e, p: float(jnp.max(jnp.abs(e - p))),
+        state2.ema_params, state2.params)
+    assert any(v > 0 for v in jax.tree.leaves(diffs))
+
+
+@pytest.mark.parametrize("policy", ["replicated", "fsdp"])
+def test_sharded_train_step_on_mesh(policy):
+    cfg = tiny_cfg()
+    env = make_mesh(MeshConfig(param_sharding=policy))
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(init_params(model, cfg, rng), cfg.train)
+    state = jax.device_put(
+        state, TrainState(step=env.replicated(),
+                          params=env.params(state.params),
+                          opt_state=env.params(state.opt_state),
+                          ema_params=env.params(state.ema_params)))
+    step_fn = make_train_step(model, cfg, env)
+    batch = jax.device_put(make_batch(cfg), env.batch())
+    state, metrics = step_fn(state, batch, rng)
+    state, metrics = step_fn(state, batch, rng)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 2
+
+
+def test_replicated_and_sharded_steps_agree():
+    """DP over the mesh computes the same update as single-device (the
+    correctness property the reference's DDP path loses, SURVEY.md §2.7)."""
+    cfg = tiny_cfg()
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    params = init_params(model, cfg, rng)
+    batch = make_batch(cfg)
+
+    s1 = create_train_state(params, cfg.train)
+    f1 = make_train_step(model, cfg, env=None, donate=False)
+    s1, m1 = f1(s1, batch, rng)
+
+    env = make_mesh()
+    s2 = create_train_state(params, cfg.train)
+    s2 = jax.device_put(
+        s2, TrainState(step=env.replicated(), params=env.params(s2.params),
+                       opt_state=env.params(s2.opt_state),
+                       ema_params=env.params(s2.ema_params)))
+    f2 = make_train_step(model, cfg, env, donate=False)
+    s2, m2 = f2(s2, jax.device_put(batch, env.batch()), rng)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-5)
+    a = jax.tree.leaves(s1.params)[0]
+    b = jax.tree.leaves(s2.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = tiny_cfg()
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(init_params(model, cfg, rng), cfg.train)
+    step_fn = make_train_step(model, cfg, env=None, donate=False)
+    state, _ = step_fn(state, make_batch(cfg), rng)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+    assert mgr.save(state, force=True)
+    mgr.wait()
+    assert mgr.latest_step() == 1
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+    restored = mgr.restore(abstract)
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_trainer_end_to_end(tmp_path):
+    cfg = tiny_cfg(max_steps=3, ckpt_every=3, log_every=1)
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=cfg.model.H)
+    loader = InfiniteLoader(ds, cfg.train.global_batch, seed=0,
+                            num_workers=0)
+    tr = Trainer(cfg, loader, workdir=str(tmp_path))
+    state = tr.train()
+    assert int(state.step) == 3
+    assert os.path.exists(tmp_path / "metrics.jsonl")
+    assert tr.ckpt.latest_step() == 3
+
+    # resume path (--transfer semantics, reference train.py:244-251)
+    loader2 = InfiniteLoader(ds, cfg.train.global_batch, seed=0,
+                             num_workers=0, start_step=3)
+    tr2 = Trainer(cfg, loader2, workdir=str(tmp_path), transfer=True)
+    assert int(tr2.state.step) == 3
