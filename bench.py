@@ -1,0 +1,86 @@
+"""Headline benchmark: training throughput on the reference's own config.
+
+Reference baseline (``BASELINE.md``): 101K steps in 120h on 8x RTX 3090 at
+SRN Cars 64x64, global batch 128 — ~0.84 train steps/s.  This bench times
+the same workload — X-UNet(H=64, W=64, ch=128), global batch 128, full
+train step (loss, grad, Adam, EMA) — on whatever devices are attached
+(one TPU chip under the driver) and prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+BASELINE_STEPS_PER_SEC = 101_000 / (120 * 3600)   # 8x3090, README.md:39
+
+
+def main() -> None:
+    import jax
+
+    try:  # persistent compile cache across driver rounds
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    except Exception:  # pragma: no cover
+        pass
+
+    from diff3d_tpu.config import srn64_config
+    from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.parallel import make_mesh
+    from diff3d_tpu.train import TrainState, create_train_state, make_train_step
+    from diff3d_tpu.train.trainer import init_params
+
+    platform = jax.devices()[0].platform
+    cfg = srn64_config()
+    global_batch = 128
+    # CPU fallback (no accelerator attached): shrink so the bench finishes;
+    # the recorded metric is still steps/s at the active batch.
+    if platform == "cpu":
+        global_batch = 8
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, global_batch=global_batch))
+
+    env = make_mesh(cfg.mesh)
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(init_params(model, cfg, rng), cfg.train)
+    state = jax.device_put(
+        state, TrainState(step=env.replicated(),
+                          params=env.params(state.params),
+                          opt_state=env.params(state.opt_state),
+                          ema_params=env.params(state.ema_params)))
+
+    ds = SyntheticDataset(num_objects=8, num_views=16,
+                          imgsize=cfg.model.H, seed=0)
+    raw = next(InfiniteLoader(ds, global_batch, seed=0))
+    batch = jax.device_put(
+        {"imgs": raw["imgs"], "R": raw["R"], "T": raw["T"], "K": raw["K"]},
+        env.batch())
+
+    step_fn = make_train_step(model, cfg, env)
+
+    # Warmup: compile + 2 steps.
+    for _ in range(2):
+        state, metrics = step_fn(state, batch, rng)
+    jax.block_until_ready(metrics["loss"])
+
+    n_steps = 10 if platform != "cpu" else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step_fn(state, batch, rng)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = n_steps / dt
+    print(json.dumps({
+        "metric": f"train_steps_per_sec_srn64_b{global_batch}_{platform}",
+        "value": round(steps_per_sec, 4),
+        "unit": "steps/s",
+        "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

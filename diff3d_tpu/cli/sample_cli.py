@@ -1,0 +1,85 @@
+"""Novel-view sampling entry point.
+
+Flag parity with the reference sampler (``/root/reference/sampling.py:
+19-23``): ``--model`` is the checkpoint to load, ``--target`` the SRN
+object directory whose views are synthesised autoregressively.  Output
+layout matches ``sampling/{step}/{gt,0..7}.png`` (``sampling.py:179-182``).
+
+Usage:
+    python -m diff3d_tpu.cli.sample_cli --model ./checkpoints \
+        --target ./data/SRN/cars_test/<object-id> [--out ./sampling]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", required=True,
+                   help="checkpoint directory (Orbax root)")
+    p.add_argument("--target", required=True,
+                   help="SRN object dir with rgb/ pose/ intrinsics/")
+    p.add_argument("--out", default="sampling")
+    p.add_argument("--config", choices=["srn64", "srn128", "test"],
+                   default="srn64")
+    p.add_argument("--steps", type=int, default=None,
+                   help="diffusion steps (reference: 256)")
+    p.add_argument("--max_views", type=int, default=None)
+    p.add_argument("--raw_params", action="store_true",
+                   help="sample with raw params instead of EMA")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    logging.getLogger("absl").setLevel(logging.WARNING)
+
+    import dataclasses
+
+    import jax
+
+    from diff3d_tpu import config as config_lib
+    from diff3d_tpu.data.srn import load_object_views
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.sampling import Sampler
+    from diff3d_tpu.train import CheckpointManager, create_train_state
+    from diff3d_tpu.train.trainer import init_params
+
+    cfg = {"srn64": config_lib.srn64_config,
+           "srn128": config_lib.srn128_config,
+           "test": config_lib.test_config}[args.config]()
+    if args.steps:
+        cfg = dataclasses.replace(
+            cfg, diffusion=dataclasses.replace(cfg.diffusion,
+                                               timesteps=args.steps))
+
+    model = XUNet(cfg.model)
+    state = create_train_state(
+        init_params(model, cfg, jax.random.PRNGKey(0)), cfg.train)
+    mgr = CheckpointManager(args.model)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+    restored = mgr.restore(abstract)
+    if restored is None:
+        raise FileNotFoundError(f"no checkpoint under {args.model}")
+    params = restored.params if args.raw_params else restored.ema_params
+    logging.info("loaded step-%d checkpoint from %s",
+                 int(restored.step), args.model)
+
+    # Load every view of the target object dir (reference sampling.py:26-48).
+    views = load_object_views(os.path.normpath(args.target), cfg.model.H)
+
+    sampler = Sampler(model, params, cfg)
+    sampler.synthesize(views, jax.random.PRNGKey(args.seed),
+                       out_dir=args.out, max_views=args.max_views)
+    logging.info("wrote %s", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -127,6 +127,15 @@ class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 
+    def validate(self) -> None:
+        self.model.validate()
+        if self.model.logsnr_clip != self.diffusion.logsnr_max:
+            raise ValueError(
+                f"model.logsnr_clip ({self.model.logsnr_clip}) must equal "
+                f"diffusion.logsnr_max ({self.diffusion.logsnr_max}) — the "
+                "noise-level embedding clip and the schedule bound are the "
+                "same quantity")
+
 
 def srn64_config() -> Config:
     """The config every reference entry point actually runs:

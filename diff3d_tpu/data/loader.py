@@ -85,6 +85,7 @@ def prefetch_to_device(it: Iterator, sharding=None, depth: int = 2,
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     stop = threading.Event()
     _SENTINEL = object()
+    error: list = []
 
     def producer():
         try:
@@ -95,6 +96,8 @@ def prefetch_to_device(it: Iterator, sharding=None, depth: int = 2,
                     batch = jax.tree.map(
                         lambda x: jax.device_put(x, sharding), batch)
                 q.put(batch)
+        except BaseException as e:  # surface on the consumer side
+            error.append(e)
         finally:
             q.put(_SENTINEL)
 
@@ -108,6 +111,8 @@ def prefetch_to_device(it: Iterator, sharding=None, depth: int = 2,
         def __next__(self):
             item = q.get()
             if item is _SENTINEL:
+                if error:
+                    raise error[0]
                 raise StopIteration
             return item
 

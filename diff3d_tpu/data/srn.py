@@ -88,6 +88,40 @@ def load_intrinsics(path: str) -> np.ndarray:
     return np.loadtxt(path).reshape(3, 3)
 
 
+def _decode_image(img, imgsize: int) -> np.ndarray:
+    """PIL image -> ``[s, s, 3] float32`` in [-1, 1] (resize, grayscale
+    promotion, alpha drop — reference ``SRNdataset.py:76-83``)."""
+    if img.size != (imgsize, imgsize):
+        img = img.resize((imgsize, imgsize))
+    arr = np.asarray(img, np.float32) / 255.0 * 2.0 - 1.0
+    if arr.ndim == 2:
+        arr = np.repeat(arr[..., None], 3, axis=-1)
+    return arr[..., :3]
+
+
+def load_object_views(object_dir: str, imgsize: int = 64
+                      ) -> Dict[str, np.ndarray]:
+    """Every view of one SRN object dir (``rgb/ pose/ intrinsics/``) — what
+    the reference sampler loads for its autoregressive loop
+    (``sampling.py:26-48``)."""
+    if not _HAVE_PIL:
+        raise RuntimeError("PIL required")
+    rgb = os.path.join(object_dir, "rgb")
+    views = sorted(f for f in os.listdir(rgb) if f.endswith(".png"))
+    if not views:
+        raise FileNotFoundError(f"no views under {rgb}")
+    imgs, Rs, Ts = [], [], []
+    for v in views:
+        imgs.append(_decode_image(Image.open(os.path.join(rgb, v)), imgsize))
+        R, T = load_pose(os.path.join(object_dir, "pose", v[:-4] + ".txt"))
+        Rs.append(R.astype(np.float32))
+        Ts.append(T.astype(np.float32))
+    K = load_intrinsics(os.path.join(object_dir, "intrinsics",
+                                     views[0][:-4] + ".txt"))
+    return {"imgs": np.stack(imgs), "R": np.stack(Rs), "T": np.stack(Ts),
+            "K": K.astype(np.float32)}
+
+
 class SRNDataset:
     """Map-style two-view dataset over SRN objects.
 
@@ -114,13 +148,9 @@ class SRNDataset:
 
     def _load_view(self, obj: str, view: str) -> Tuple[np.ndarray, np.ndarray,
                                                        np.ndarray]:
-        img = Image.open(os.path.join(self.path, obj, "rgb", view))
-        if img.size != (self.imgsize, self.imgsize):
-            img = img.resize((self.imgsize, self.imgsize))
-        arr = np.asarray(img, np.float32) / 255.0 * 2.0 - 1.0
-        if arr.ndim == 2:
-            arr = np.repeat(arr[..., None], 3, axis=-1)
-        arr = arr[..., :3]                       # drop alpha, keep NHWC
+        arr = _decode_image(
+            Image.open(os.path.join(self.path, obj, "rgb", view)),
+            self.imgsize)
         R, T = load_pose(
             os.path.join(self.path, obj, "pose", view[:-4] + ".txt"))
         return arr, R.astype(np.float32), T.astype(np.float32)

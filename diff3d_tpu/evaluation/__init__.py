@@ -1,0 +1,6 @@
+from diff3d_tpu.evaluation.metrics import psnr, ssim
+from diff3d_tpu.evaluation.fid import (FIDStats, fid_from_stats,
+                                       gaussian_stats, frechet_distance)
+
+__all__ = ["psnr", "ssim", "FIDStats", "fid_from_stats", "gaussian_stats",
+           "frechet_distance"]

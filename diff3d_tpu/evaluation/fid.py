@@ -1,0 +1,99 @@
+"""Frechet Inception Distance harness.
+
+FID = |mu_r - mu_g|^2 + tr(C_r + C_g - 2 (C_r C_g)^{1/2}) between Gaussian
+fits to feature distributions of real and generated images.  The feature
+extractor is pluggable: the canonical choice is InceptionV3 pool3; this
+zero-egress image has no pretrained weights, so the default extractor is a
+fixed random-projection + average-pool embedding (deterministic, seeded) —
+statistically meaningful for *relative* comparisons within this framework,
+and swappable for true Inception features by passing ``feature_fn``.
+
+(The reference has no evaluation code at all — SURVEY.md §5.5.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class FIDStats:
+    mu: np.ndarray      # [D]
+    cov: np.ndarray     # [D, D]
+    n: int
+
+
+def default_feature_fn(dim: int = 256, seed: int = 0
+                       ) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Fixed random conv features: 4x4/4 patch embed -> ReLU -> global
+    mean/std pool -> projection to ``dim``.  Deterministic given ``seed``."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+
+    w_cache = {}
+
+    def feats(imgs: jnp.ndarray) -> jnp.ndarray:
+        C = imgs.shape[-1]
+        if "w" not in w_cache:
+            w_cache["w"] = jax.random.normal(
+                k1, (4, 4, C, dim)) / np.sqrt(4 * 4 * C)
+            w_cache["p"] = jax.random.normal(k2, (2 * dim, dim)) / np.sqrt(
+                2 * dim)
+        h = jax.lax.conv_general_dilated(
+            imgs, w_cache["w"], window_strides=(4, 4), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        h = jax.nn.relu(h)
+        pooled = jnp.concatenate([h.mean(axis=(1, 2)), h.std(axis=(1, 2))],
+                                 axis=-1)
+        return pooled @ w_cache["p"]
+
+    return feats
+
+
+def gaussian_stats(batches: Iterable[np.ndarray],
+                   feature_fn: Optional[Callable] = None) -> FIDStats:
+    """Streaming mean/cov of features over image batches ``[B, H, W, C]``."""
+    feature_fn = feature_fn or default_feature_fn()
+    f = jax.jit(feature_fn)
+    s = None
+    for batch in batches:
+        x = np.asarray(f(jnp.asarray(batch)), np.float64)
+        if s is None:
+            s = {"sum": np.zeros(x.shape[1]),
+                 "outer": np.zeros((x.shape[1], x.shape[1])), "n": 0}
+        s["sum"] += x.sum(0)
+        s["outer"] += x.T @ x
+        s["n"] += x.shape[0]
+    if s is None or s["n"] < 2:
+        raise ValueError("need at least 2 images for FID stats")
+    mu = s["sum"] / s["n"]
+    cov = (s["outer"] - s["n"] * np.outer(mu, mu)) / (s["n"] - 1)
+    return FIDStats(mu=mu, cov=cov, n=s["n"])
+
+
+def frechet_distance(a: FIDStats, b: FIDStats, eps: float = 1e-6) -> float:
+    """``|mu_a-mu_b|^2 + tr(Ca + Cb - 2 (Ca Cb)^{1/2})`` with the symmetric
+    sqrt trick: ``tr((Ca Cb)^{1/2}) = tr((Ca^{1/2} Cb Ca^{1/2})^{1/2})``."""
+    diff = a.mu - b.mu
+
+    # symmetric PSD square root via eigh
+    def sqrtm_psd(m):
+        vals, vecs = np.linalg.eigh(m)
+        vals = np.clip(vals, 0.0, None)
+        return (vecs * np.sqrt(vals)) @ vecs.T
+
+    ca = a.cov + eps * np.eye(a.cov.shape[0])
+    cb = b.cov + eps * np.eye(b.cov.shape[0])
+    sa = sqrtm_psd(ca)
+    inner = sa @ cb @ sa
+    vals = np.clip(np.linalg.eigvalsh(inner), 0.0, None)
+    tr_sqrt = float(np.sqrt(vals).sum())
+    return float(diff @ diff + np.trace(ca) + np.trace(cb) - 2.0 * tr_sqrt)
+
+
+def fid_from_stats(real: FIDStats, gen: FIDStats) -> float:
+    return frechet_distance(real, gen)

@@ -1,0 +1,58 @@
+"""Image-quality metrics, pure jnp.
+
+The reference publishes no quality numbers and ships no evaluation code
+(SURVEY.md §5.5, §6) despite FID/PSNR being the paper's headline metrics —
+this harness is a capability the TPU build adds.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def psnr(a: jnp.ndarray, b: jnp.ndarray, max_val: float = 2.0) -> jnp.ndarray:
+    """Peak signal-to-noise ratio per image pair.
+
+    ``a``, ``b``: ``[..., H, W, C]``; ``max_val`` is the data range (2.0
+    for the framework's [-1, 1] images).  Returns ``[...]`` dB.
+    """
+    mse = jnp.mean(jnp.square(a - b), axis=(-3, -2, -1))
+    return 10.0 * jnp.log10(max_val ** 2 / jnp.maximum(mse, 1e-12))
+
+
+def _gaussian_kernel(size: int, sigma: float) -> jnp.ndarray:
+    x = jnp.arange(size, dtype=jnp.float32) - (size - 1) / 2.0
+    g = jnp.exp(-0.5 * (x / sigma) ** 2)
+    return g / g.sum()
+
+
+def ssim(a: jnp.ndarray, b: jnp.ndarray, max_val: float = 2.0,
+         filter_size: int = 11, filter_sigma: float = 1.5,
+         k1: float = 0.01, k2: float = 0.03) -> jnp.ndarray:
+    """Structural similarity (Wang et al. 2004) with the standard 11x1
+    separable Gaussian window.  ``a``, ``b``: ``[..., H, W, C]``; returns
+    mean SSIM over pixels/channels per image, ``[...]``."""
+    kern = _gaussian_kernel(filter_size, filter_sigma)
+
+    def blur(x):
+        # separable conv along H then W via tensordot-free moving window
+        pad = filter_size // 2
+        xp = jnp.pad(x, [(0, 0)] * (x.ndim - 3) + [(pad, pad), (0, 0),
+                                                   (0, 0)], mode="edge")
+        xh = sum(kern[i] * xp[..., i:i + x.shape[-3], :, :]
+                 for i in range(filter_size))
+        xp = jnp.pad(xh, [(0, 0)] * (x.ndim - 3) + [(0, 0), (pad, pad),
+                                                    (0, 0)], mode="edge")
+        return sum(kern[i] * xp[..., :, i:i + x.shape[-2], :]
+                   for i in range(filter_size))
+
+    c1 = (k1 * max_val) ** 2
+    c2 = (k2 * max_val) ** 2
+    mu_a, mu_b = blur(a), blur(b)
+    var_a = blur(a * a) - mu_a ** 2
+    var_b = blur(b * b) - mu_b ** 2
+    cov = blur(a * b) - mu_a * mu_b
+    num = (2 * mu_a * mu_b + c1) * (2 * cov + c2)
+    den = (mu_a ** 2 + mu_b ** 2 + c1) * (var_a + var_b + c2)
+    return jnp.mean(num / den, axis=(-3, -2, -1))

@@ -22,27 +22,30 @@ def maybe_initialize_distributed(coordinator_address: str | None = None,
                                  process_id: int | None = None) -> bool:
     """Initialise JAX's multi-host runtime if we're in a multi-process job.
 
-    Safe to call unconditionally: single-process (one host, N local chips)
-    skips initialisation, and a second call is a no-op.  Returns True when
-    the distributed client is live.
+    MUST run before any other JAX call (``jax.distributed.initialize``
+    refuses once a backend exists) — call it first thing in ``main``.
+    Single-process environments (no coordinator configured) fall through
+    and return False; an already-initialised runtime returns True.
     """
-    if jax.process_count() > 1:
-        return True  # already initialised (e.g. by the launcher)
-    explicit = coordinator_address is not None
-    if not explicit and jax.default_backend() != "tpu":
-        return False
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
-        log.info("jax.distributed up: process %d/%d, %d global devices",
-                 jax.process_index(), jax.process_count(),
-                 jax.device_count())
-        return True
-    except (RuntimeError, ValueError) as e:
-        # Single-host TPU (no coordinator env) lands here; that's fine.
-        log.debug("jax.distributed.initialize skipped: %s", e)
+    except RuntimeError as e:
+        # Either already initialised (fine) or initialise-after-backend-use
+        # (a real bug in the caller's ordering) — distinguish loudly.
+        if "already" in str(e).lower():
+            return jax.process_count() > 1
+        log.warning("jax.distributed.initialize failed: %s", e)
+        return jax.process_count() > 1
+    except ValueError as e:
+        # No coordinator available: single-process run (CPU dev box or
+        # single-host TPU without a pod runtime).
+        log.debug("single-process run (no coordinator): %s", e)
         return False
+    log.info("jax.distributed up: process %d/%d, %d global devices",
+             jax.process_index(), jax.process_count(), jax.device_count())
+    return True
 
 
 def is_primary() -> bool:

@@ -1,0 +1,3 @@
+from diff3d_tpu.sampling.runtime import Sampler, save_image_grid
+
+__all__ = ["Sampler", "save_image_grid"]

@@ -87,18 +87,18 @@ def make_train_step(model, cfg: Config, env: MeshEnv | None = None,
             ema_params=env.params(state.ema_params),
         )
 
-    compiled_cache = {}
+    jitted = None  # built on first call (shardings come from the pytrees)
 
     def sharded_step(state, batch, rng):
-        key = True
-        if key not in compiled_cache:
+        nonlocal jitted
+        if jitted is None:
             st_sh = shard_for_state(state)
             batch_shardings = jax.tree.map(lambda _: batch_sh, batch)
-            compiled_cache[key] = jax.jit(
+            jitted = jax.jit(
                 step_fn,
                 in_shardings=(st_sh, batch_shardings, rep),
                 out_shardings=(st_sh, rep),
                 donate_argnums=(0,) if donate else ())
-        return compiled_cache[key](state, batch, rng)
+        return jitted(state, batch, rng)
 
     return sharded_step

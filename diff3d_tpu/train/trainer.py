@@ -50,9 +50,13 @@ def init_params(model: XUNet, cfg: Config, rng: jax.Array):
 
 
 class Trainer:
-    def __init__(self, cfg: Config, loader: Iterator,
+    def __init__(self, cfg: Config, loader: Optional[Iterator] = None,
                  env: Optional[MeshEnv] = None,
                  workdir: str = ".", transfer: bool = False):
+        """``loader`` may be attached after construction (``self.loader``) —
+        a resuming caller needs the restored step (``int(self.state.step)``)
+        to build a loader that seeks the data stream to the right batch."""
+        cfg.validate()
         self.cfg = cfg
         self.loader = loader
         self.env = env or make_mesh(cfg.mesh)
@@ -104,17 +108,22 @@ class Trainer:
             f.write(json.dumps(record) + "\n")
 
     def train(self, max_steps: Optional[int] = None) -> TrainState:
+        if self.loader is None:
+            raise ValueError("attach a loader before train()")
         cfg = self.cfg.train
         max_steps = max_steps if max_steps is not None else cfg.max_steps
         t0 = time.monotonic()
-        window_start, window_t = int(self.state.step), t0
+        # Host-side step mirror: avoids a device sync per iteration (the
+        # jitted step runs async; we only block at log boundaries).
+        step = int(self.state.step)
+        window_start, window_t = step, t0
 
-        while int(self.state.step) < max_steps:
+        while step < max_steps:
             batch = next(self.loader)
             batch = {"imgs": batch["imgs"], "R": batch["R"],
                      "T": batch["T"], "K": batch["K"]}
             self.state, metrics = self.step_fn(self.state, batch, self.rng)
-            step = int(self.state.step)
+            step += 1
 
             if step % cfg.log_every == 0 or step >= max_steps:
                 jax.block_until_ready(metrics["loss"])

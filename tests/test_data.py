@@ -134,3 +134,17 @@ def test_prefetch_to_device_shards_batch():
     assert batch["imgs"].shape == (8, 2, 8, 8, 3)
     assert batch["imgs"].sharding.is_equivalent_to(env.batch(), 5)
     it.close()
+
+
+def test_prefetch_propagates_producer_errors():
+    from diff3d_tpu.data.loader import prefetch_to_device
+
+    def bad_iter():
+        yield {"x": np.zeros(2)}
+        raise RuntimeError("corrupt sample")
+
+    it = prefetch_to_device(bad_iter(), sharding=None, depth=1,
+                            to_device=False)
+    next(it)
+    with pytest.raises(RuntimeError, match="corrupt sample"):
+        next(it)

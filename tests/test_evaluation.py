@@ -1,0 +1,54 @@
+import jax.numpy as jnp
+import numpy as np
+
+from diff3d_tpu.evaluation import (fid_from_stats, frechet_distance,
+                                   gaussian_stats, psnr, ssim)
+
+
+def test_psnr_identity_and_known_value():
+    a = jnp.zeros((2, 8, 8, 3))
+    assert float(psnr(a, a)[0]) > 100.0
+    # mse = 1, range 2 -> 10 log10(4) ~ 6.02 dB
+    b = jnp.ones((2, 8, 8, 3))
+    np.testing.assert_allclose(np.asarray(psnr(a, b)), 6.0206, atol=1e-3)
+
+
+def test_psnr_monotone_in_noise():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.uniform(-1, 1, (1, 16, 16, 3)), jnp.float32)
+    small = float(psnr(a, a + 0.01)[0])
+    large = float(psnr(a, a + 0.1)[0])
+    assert small > large
+
+
+def test_ssim_bounds_and_identity():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.uniform(-1, 1, (2, 16, 16, 3)), jnp.float32)
+    s_self = np.asarray(ssim(a, a))
+    np.testing.assert_allclose(s_self, 1.0, atol=1e-4)
+    noise = jnp.asarray(rng.normal(0, 0.5, a.shape), jnp.float32)
+    s_noisy = np.asarray(ssim(a, a + noise))
+    assert (s_noisy < s_self).all()
+    assert (s_noisy > -1.0 - 1e-6).all()
+
+
+def test_fid_zero_for_identical_and_positive_for_shifted():
+    rng = np.random.default_rng(0)
+    imgs = rng.uniform(-1, 1, (64, 8, 8, 3)).astype(np.float32)
+    s1 = gaussian_stats([imgs[:32], imgs[32:]])
+    s2 = gaussian_stats([imgs[:32], imgs[32:]])
+    assert abs(fid_from_stats(s1, s2)) < 1e-6
+    shifted = np.clip(imgs + 0.5, -1, 1)
+    s3 = gaussian_stats([shifted])
+    assert fid_from_stats(s1, s3) > 0.01
+
+
+def test_frechet_distance_closed_form_1d_like():
+    """Two Gaussians with equal cov: FID = |mu1 - mu2|^2."""
+    from diff3d_tpu.evaluation.fid import FIDStats
+
+    d = 4
+    cov = np.eye(d)
+    a = FIDStats(mu=np.zeros(d), cov=cov, n=100)
+    b = FIDStats(mu=np.full(d, 2.0), cov=cov, n=100)
+    np.testing.assert_allclose(frechet_distance(a, b), d * 4.0, atol=1e-4)

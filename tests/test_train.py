@@ -172,3 +172,13 @@ def test_trainer_end_to_end(tmp_path):
                              num_workers=0, start_step=3)
     tr2 = Trainer(cfg, loader2, workdir=str(tmp_path), transfer=True)
     assert int(tr2.state.step) == 3
+
+
+def test_config_validate_rejects_clip_schedule_mismatch():
+    import dataclasses
+    from diff3d_tpu.config import DiffusionConfig
+    cfg = tiny_cfg()
+    bad = dataclasses.replace(
+        cfg, diffusion=dataclasses.replace(cfg.diffusion, logsnr_max=15.0))
+    with pytest.raises(ValueError, match="logsnr_clip"):
+        bad.validate()
