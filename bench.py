@@ -1,10 +1,18 @@
 """Headline benchmark: training throughput on the reference's own config.
 
 Reference baseline (``BASELINE.md``): 101K steps in 120h on 8x RTX 3090 at
-SRN Cars 64x64, global batch 128 — ~0.84 train steps/s.  This bench times
-the same workload — X-UNet(H=64, W=64, ch=128), global batch 128, full
-train step (loss, grad, Adam, EMA) — on whatever devices are attached
-(one TPU chip under the driver) and prints ONE JSON line.
+SRN Cars 64x64, global batch 128 — 0.2338 train steps/s = 29.9 examples/s.
+This bench times the same workload — X-UNet(H=64, W=64, ch=128), full
+train step (loss, grad, Adam, EMA), bf16 compute + per-block remat — on
+whatever devices are attached (one TPU chip under the driver; the mesh
+scales the same program to a pod) and prints ONE JSON line.
+
+``vs_baseline`` compares **examples/s** against the reference's 29.9: the
+hardware differs (8 GPUs there, whatever is attached here), so throughput,
+not step cadence, is the comparable quantity.  The global batch adapts
+downward (128 -> 64 -> 32 per try) if the attached HBM can't hold the
+reference's 128 — a single v5e is ~1/8 the memory of the reference's 8-GPU
+rig that the 128-batch config was sized for.
 """
 
 from __future__ import annotations
@@ -15,15 +23,11 @@ import sys
 import time
 
 BASELINE_STEPS_PER_SEC = 101_000 / (120 * 3600)   # 8x3090, README.md:39
+BASELINE_EXAMPLES_PER_SEC = BASELINE_STEPS_PER_SEC * 128
 
 
-def main() -> None:
+def _run(global_batch: int, n_steps: int):
     import jax
-
-    try:  # persistent compile cache across driver rounds
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-    except Exception:  # pragma: no cover
-        pass
 
     from diff3d_tpu.config import srn64_config
     from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
@@ -32,15 +36,11 @@ def main() -> None:
     from diff3d_tpu.train import TrainState, create_train_state, make_train_step
     from diff3d_tpu.train.trainer import init_params
 
-    platform = jax.devices()[0].platform
     cfg = srn64_config()
-    global_batch = 128
-    # CPU fallback (no accelerator attached): shrink so the bench finishes;
-    # the recorded metric is still steps/s at the active batch.
-    if platform == "cpu":
-        global_batch = 8
     cfg = dataclasses.replace(
-        cfg, train=dataclasses.replace(cfg.train, global_batch=global_batch))
+        cfg,
+        model=dataclasses.replace(cfg.model, remat=True),
+        train=dataclasses.replace(cfg.train, global_batch=global_batch))
 
     env = make_mesh(cfg.mesh)
     model = XUNet(cfg.model)
@@ -66,19 +66,50 @@ def main() -> None:
         state, metrics = step_fn(state, batch, rng)
     jax.block_until_ready(metrics["loss"])
 
-    n_steps = 10 if platform != "cpu" else 3
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step_fn(state, batch, rng)
     jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    return n_steps / (time.perf_counter() - t0)
 
-    steps_per_sec = n_steps / dt
+
+def main() -> None:
+    import jax
+
+    try:  # persistent compile cache across driver rounds
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    except Exception:  # pragma: no cover
+        pass
+
+    platform = jax.devices()[0].platform
+    # CPU fallback (no accelerator attached): tiny so the bench finishes.
+    batches = [128, 64, 32] if platform != "cpu" else [8]
+    n_steps = 10 if platform != "cpu" else 3
+
+    steps_per_sec, global_batch, err = None, None, None
+    for global_batch in batches:
+        try:
+            steps_per_sec = _run(global_batch, n_steps)
+            break
+        except Exception as e:  # XlaRuntimeError (OOM) etc.
+            if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(
+                    e).lower():
+                raise
+            # Keep only the message: holding the exception would pin the
+            # failed attempt's traceback frames (train state, batch) and
+            # their HBM buffers across the retry.
+            err = str(e).splitlines()[0]
+    if steps_per_sec is None:
+        raise SystemExit(f"bench failed at every batch size: {err}")
+
+    examples_per_sec = steps_per_sec * global_batch
     print(json.dumps({
-        "metric": f"train_steps_per_sec_srn64_b{global_batch}_{platform}",
-        "value": round(steps_per_sec, 4),
-        "unit": "steps/s",
-        "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 4),
+        "metric": f"train_examples_per_sec_srn64_b{global_batch}_{platform}"
+                  f"_x{len(jax.devices())}",
+        "value": round(examples_per_sec, 2),
+        "unit": "examples/s",
+        "vs_baseline": round(examples_per_sec / BASELINE_EXAMPLES_PER_SEC,
+                             4),
     }))
 
 

@@ -114,8 +114,11 @@ class MeshConfig:
     model_axis: str = "model"
     data_parallel: int = -1           # -1: all devices
     model_parallel: int = 1
-    # 'fsdp' shards params+opt state over the data axis (ZeRO-ish);
-    # 'replicated' keeps them replicated like the reference's DDP.
+    # 'replicated' keeps params/opt-state replicated like the reference's
+    # DDP; 'fsdp' shards them over the data axis (ZeRO-ish); 'tp' applies
+    # Megatron-style rules over the model axis (attention q/k/v column-,
+    # out-proj row-parallel, conv output channels); 'fsdp+tp' composes
+    # both (TP rule first, then the largest free axis over data).
     param_sharding: str = "replicated"
 
 

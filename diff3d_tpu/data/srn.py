@@ -35,6 +35,8 @@ try:  # PIL ships with the image; gate anyway so array-only use works.
 except ImportError:  # pragma: no cover
     _HAVE_PIL = False
 
+from diff3d_tpu import native
+
 
 def build_index(path: str, picklefile: str | None = None,
                 save: bool = False) -> Dict[str, List[str]]:
@@ -90,13 +92,40 @@ def load_intrinsics(path: str) -> np.ndarray:
 
 def _decode_image(img, imgsize: int) -> np.ndarray:
     """PIL image -> ``[s, s, 3] float32`` in [-1, 1] (resize, grayscale
-    promotion, alpha drop — reference ``SRNdataset.py:76-83``)."""
+    promotion, alpha drop — reference ``SRNdataset.py:76-83``).  BOX
+    (area-average) resampling, matching the native C++ decoder exactly."""
     if img.size != (imgsize, imgsize):
-        img = img.resize((imgsize, imgsize))
+        img = img.resize((imgsize, imgsize), Image.BOX)
     arr = np.asarray(img, np.float32) / 255.0 * 2.0 - 1.0
     if arr.ndim == 2:
         arr = np.repeat(arr[..., None], 3, axis=-1)
     return arr[..., :3]
+
+
+def load_view_image(path: str, imgsize: int,
+                    use_native: bool = True) -> np.ndarray:
+    """One view png -> ``[s, s, 3] float32`` in [-1, 1].  Routes through the
+    C++ decoder (:mod:`diff3d_tpu.native`) when available — ctypes releases
+    the GIL for the native call, so loader threads decode truly in parallel
+    — else the PIL path."""
+    if use_native and native.available():
+        return native.decode_image(path, imgsize)
+    if not _HAVE_PIL:
+        raise RuntimeError("neither native decoder nor PIL available")
+    return _decode_image(Image.open(path), imgsize)
+
+
+def decode_view_batch(paths: Sequence[str], imgsize: int,
+                      use_native: bool = True) -> np.ndarray:
+    """``[N, s, s, 3]`` for N view pngs.  One call into the shared C++
+    worker pool (GIL-free, decodes in parallel) when available; PIL loop
+    otherwise."""
+    if use_native:
+        pool = native.shared_pool()
+        if pool is not None:
+            return pool.decode_batch(list(paths), imgsize)
+    return np.stack([load_view_image(p, imgsize, use_native=False)
+                     for p in paths])
 
 
 def load_object_views(object_dir: str, imgsize: int = 64
@@ -104,21 +133,19 @@ def load_object_views(object_dir: str, imgsize: int = 64
     """Every view of one SRN object dir (``rgb/ pose/ intrinsics/``) — what
     the reference sampler loads for its autoregressive loop
     (``sampling.py:26-48``)."""
-    if not _HAVE_PIL:
-        raise RuntimeError("PIL required")
     rgb = os.path.join(object_dir, "rgb")
     views = sorted(f for f in os.listdir(rgb) if f.endswith(".png"))
     if not views:
         raise FileNotFoundError(f"no views under {rgb}")
-    imgs, Rs, Ts = [], [], []
+    imgs = decode_view_batch([os.path.join(rgb, v) for v in views], imgsize)
+    Rs, Ts = [], []
     for v in views:
-        imgs.append(_decode_image(Image.open(os.path.join(rgb, v)), imgsize))
         R, T = load_pose(os.path.join(object_dir, "pose", v[:-4] + ".txt"))
         Rs.append(R.astype(np.float32))
         Ts.append(T.astype(np.float32))
     K = load_intrinsics(os.path.join(object_dir, "intrinsics",
                                      views[0][:-4] + ".txt"))
-    return {"imgs": np.stack(imgs), "R": np.stack(Rs), "T": np.stack(Ts),
+    return {"imgs": imgs, "R": np.stack(Rs), "T": np.stack(Ts),
             "K": K.astype(np.float32)}
 
 
@@ -131,12 +158,14 @@ class SRNDataset:
 
     def __init__(self, split: str, path: str, picklefile: str | None = None,
                  imgsize: int = 64, split_seed: int = 0,
-                 train_fraction: float = 0.9, num_views: int = 2):
-        if not _HAVE_PIL:
+                 train_fraction: float = 0.9, num_views: int = 2,
+                 use_native: bool = True):
+        if not _HAVE_PIL and not (use_native and native.available()):
             raise RuntimeError("PIL required for SRNDataset image loading")
         self.path = path
         self.imgsize = imgsize
         self.num_views = num_views
+        self.use_native = use_native
         self.index = build_index(path, picklefile)
         self.ids = split_ids(list(self.index.keys()), split, split_seed,
                              train_fraction)
@@ -146,39 +175,30 @@ class SRNDataset:
     def __len__(self) -> int:
         return len(self.ids)
 
-    def _load_view(self, obj: str, view: str) -> Tuple[np.ndarray, np.ndarray,
-                                                       np.ndarray]:
-        arr = _decode_image(
-            Image.open(os.path.join(self.path, obj, "rgb", view)),
-            self.imgsize)
-        R, T = load_pose(
-            os.path.join(self.path, obj, "pose", view[:-4] + ".txt"))
-        return arr, R.astype(np.float32), T.astype(np.float32)
+    def _load_views(self, obj: str, names: Sequence[str]
+                    ) -> Dict[str, np.ndarray]:
+        imgs = decode_view_batch(
+            [os.path.join(self.path, obj, "rgb", v) for v in names],
+            self.imgsize, use_native=self.use_native)
+        Rs, Ts = zip(*(load_pose(
+            os.path.join(self.path, obj, "pose", v[:-4] + ".txt"))
+            for v in names))
+        K = load_intrinsics(os.path.join(
+            self.path, obj, "intrinsics", self.index[obj][0][:-4] + ".txt"))
+        return {
+            "imgs": imgs.astype(np.float32),
+            "R": np.stack(Rs).astype(np.float32),
+            "T": np.stack(Ts).astype(np.float32),
+            "K": K.astype(np.float32),
+        }
 
     def sample(self, idx: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
         obj = self.ids[idx]
         views = self.index[obj]
         chosen = rng.choice(len(views), size=self.num_views, replace=False)
-        imgs, Rs, Ts = zip(*(self._load_view(obj, views[i]) for i in chosen))
-        K = load_intrinsics(os.path.join(
-            self.path, obj, "intrinsics", views[0][:-4] + ".txt"))
-        return {
-            "imgs": np.stack(imgs).astype(np.float32),
-            "R": np.stack(Rs),
-            "T": np.stack(Ts),
-            "K": K.astype(np.float32),
-        }
+        return self._load_views(obj, [views[i] for i in chosen])
 
     def all_views(self, obj: str) -> Dict[str, np.ndarray]:
         """Every view of one object, for the sampler's autoregressive loop
         (reference ``sampling.py:26-48`` loads the whole target dir)."""
-        views = self.index[obj]
-        imgs, Rs, Ts = zip(*(self._load_view(obj, v) for v in views))
-        K = load_intrinsics(os.path.join(
-            self.path, obj, "intrinsics", views[0][:-4] + ".txt"))
-        return {
-            "imgs": np.stack(imgs).astype(np.float32),
-            "R": np.stack(Rs),
-            "T": np.stack(Ts),
-            "K": K.astype(np.float32),
-        }
+        return self._load_views(obj, self.index[obj])

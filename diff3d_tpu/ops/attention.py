@@ -15,16 +15,15 @@ All shapes here are ``[B, L, n_heads, head_dim]`` (jax.nn convention).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
 
-@functools.cache
 def _default_backend() -> str:
+    # NOT cached: a process can trace for several backends (e.g. a TPU
+    # entry check followed by a CPU-mesh dry run).
     try:
-        platform = jax.devices()[0].platform
+        platform = jax.default_backend()
     except RuntimeError:  # no backend at trace time; be conservative
         platform = "cpu"
     return "pallas" if platform == "tpu" else "xla"

@@ -1,19 +1,360 @@
-"""TPU Pallas flash-attention kernel (stub pending; see ops/attention.py).
+"""TPU Pallas flash attention (forward + backward).
 
-Until the kernel lands, ``supports()`` returns False and the dispatcher
-falls back to ``jax.nn.dot_product_attention`` (which XLA fuses well on TPU
-for the model's 4096-16384 token sequences).
+Replaces the reference's ``torch.nn.MultiheadAttention`` sdpa core
+(``/root/reference/xunet.py:154-177``, which delegates to cuDNN) with a
+hand-tiled TPU kernel:
+
+  * **forward** — online-softmax flash attention: the KV sequence is
+    streamed through VMEM in ``block_k`` tiles while running max / sum /
+    output accumulators live in VMEM scratch; one QK^T and one PV matmul
+    per tile hit the MXU, nothing of size ``[Lq, Lk]`` ever touches HBM.
+    Under differentiation the per-row log-sum-exp is written out as the
+    backward residual (lane-replicated to a ``[.., 128]`` tile — TPU
+    output blocks need the last two dims (8, 128)-aligned); the inference
+    path skips the residual entirely.
+  * **backward** — the standard two-kernel flash backward: one kernel
+    accumulating dK/dV over query tiles and one accumulating dQ over key
+    tiles, each recomputing the probabilities from (Q, K, lse).  The
+    ``delta = rowsum(dO * O)`` term is computed in-kernel from the dO/O
+    blocks (the padded head dim fits one 128-lane tile, so the row sum is
+    block-local).
+
+Head dim is zero-padded to the 128 lane width and sequence lengths to the
+tile size; padded key columns are masked to -1e30 before the softmax so
+both passes ignore them.  All accumulation is float32 regardless of input
+dtype (bf16 inputs still use the MXU with f32 accumulation via
+``preferred_element_type``).
+
+On non-TPU backends the kernels run in Pallas interpret mode (tests); the
+dispatcher in :mod:`diff3d_tpu.ops.attention` only routes here on TPU.
 """
 
 from __future__ import annotations
 
+import functools
+from typing import Optional
+
+import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable without TPU; used for CompilerParams only
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+LANE = 128          # TPU lane width: head dim is padded to this
+MIN_SUBLANE = 8     # f32 sublane granularity: seq tiles padded to this
+NEG_INF = -1e30
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
 
 
 def supports(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> bool:
-    return False
+    """Shapes/dtypes this kernel handles: ``[B, L, H, D]`` with D <= LANE."""
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        return False
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    D = q.shape[-1]
+    return D <= LANE and k.shape[-1] == D and v.shape[-1] == D
 
 
-def flash_attention(q: jnp.ndarray, k: jnp.ndarray,
-                    v: jnp.ndarray) -> jnp.ndarray:
-    raise NotImplementedError("pallas flash attention kernel pending")
+def _block_sizes(Lq: int, Lk: int) -> tuple[int, int, int, int]:
+    """Pick (block_q, block_k, Lq_pad, Lk_pad)."""
+    bq = 128 if Lq >= 128 else _round_up(Lq, MIN_SUBLANE)
+    bk = 128 if Lk >= 128 else _round_up(Lk, MIN_SUBLANE)
+    return bq, bk, _round_up(Lq, bq), _round_up(Lk, bk)
+
+
+def _key_mask(ki: jax.Array, block_k: int, Lk: int) -> jnp.ndarray:
+    """[1, block_k] bool — True for real (non-pad) key columns."""
+    col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    return col < Lk
+
+
+def _compiler_params(interpret: bool):
+    if pltpu is None or interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def _vmem(shape):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, jnp.float32)
+    return pl.ANY  # pragma: no cover
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_then_scratch,
+                scale: float, Lk: int, block_k: int, save_lse: bool):
+    if save_lse:
+        lse_ref, m_scr, l_scr, acc_scr = maybe_lse_then_scratch
+    else:
+        m_scr, l_scr, acc_scr = maybe_lse_then_scratch
+        lse_ref = None
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)                       # [bq, LANE]
+    k = k_ref[0].astype(jnp.float32)                       # [bk, LANE]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = jnp.where(_key_mask(ki, block_k, Lk), s, NEG_INF)  # [bq, bk]
+
+    m_prev = m_scr[:, :1]                                  # [bq, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)             # [bq, 1]
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)                        # rescale old acc
+    p = jnp.exp(s - m_new)                                 # [bq, bk]
+
+    l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    v = v_ref[0].astype(jnp.float32)                       # [bk, LANE]
+    pv = jnp.dot(p, v, preferred_element_type=jnp.float32)
+    acc_scr[...] = acc_scr[...] * alpha + pv
+
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        if save_lse:
+            lse = m_scr[:, :1] + jnp.log(l_safe)           # [bq, 1]
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _fwd_call(q, k, v, *, scale: float, Lq: int, Lk: int, interpret: bool,
+              save_lse: bool):
+    """q/k/v: ``[N, L_pad, LANE]``.  Returns ``o`` (and ``lse
+    [N, Lq_pad, LANE]`` lane-replicated when ``save_lse``)."""
+    N, Lq_pad, _ = q.shape
+    Lk_pad = k.shape[1]
+    bq, bk, _, _ = _block_sizes(Lq_pad, Lk_pad)
+    grid = (N, Lq_pad // bq, Lk_pad // bk)
+
+    qo_spec = pl.BlockSpec((1, bq, LANE), lambda n, qi, ki: (n, qi, 0))
+    kv_spec = pl.BlockSpec((1, bk, LANE), lambda n, qi, ki: (n, ki, 0))
+    out_specs = [qo_spec]
+    out_shape = [jax.ShapeDtypeStruct((N, Lq_pad, LANE), q.dtype)]
+    if save_lse:
+        out_specs.append(qo_spec)
+        out_shape.append(
+            jax.ShapeDtypeStruct((N, Lq_pad, LANE), jnp.float32))
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, Lk=Lk, block_k=bk,
+                               save_lse=save_lse)
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qo_spec, kv_spec, kv_spec],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            _vmem((bq, LANE)), _vmem((bq, LANE)), _vmem((bq, LANE)),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v)
+    return (outs[0], outs[1]) if save_lse else (outs[0], None)
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                     Lk: int, block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0].astype(jnp.float32)                       # [bq, LANE]
+    k = k_ref[0].astype(jnp.float32)                       # [bk, LANE]
+    v = v_ref[0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)                       # [bq, LANE]
+    do = do_ref[0].astype(jnp.float32)                     # [bq, LANE]
+    lse = lse_ref[0][:, :1]                                # [bq, 1]
+    # delta = rowsum(dO * O): block-local (LANE covers the whole head dim)
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)        # [bq, 1]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = jnp.where(_key_mask(ki, block_k, Lk), s, NEG_INF)
+    p = jnp.exp(s - lse)                                   # [bq, bk]
+
+    # dV += P^T dO ; dP = dO V^T ; dS = P*(dP - delta) ; dK += dS^T Q
+    dv_scr[...] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dk_scr[...] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                   dq_ref, dq_scr, *, scale: float, Lk: int, block_k: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = jnp.where(_key_mask(ki, block_k, Lk), s, NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale                          # [bq, bk]
+    dq_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, lse, do, *, scale: float, Lq: int, Lk: int,
+              interpret: bool):
+    N, Lq_pad, _ = q.shape
+    Lk_pad = k.shape[1]
+    bq, bk, _, _ = _block_sizes(Lq_pad, Lk_pad)
+
+    q_spec = pl.BlockSpec((1, bq, LANE), lambda n, a, b: (n, b, 0))
+    k_spec = pl.BlockSpec((1, bk, LANE), lambda n, ki, qi: (n, ki, 0))
+    dkdv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, scale=scale, Lk=Lk, block_k=bk),
+        grid=(N, Lk_pad // bk, Lq_pad // bq),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, q_spec],
+        out_specs=[k_spec, k_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, Lk_pad, LANE), q.dtype),
+            jax.ShapeDtypeStruct((N, Lk_pad, LANE), q.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, LANE)), _vmem((bk, LANE))],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )
+    dk, dv = dkdv(q, k, v, o, do, lse)
+
+    q2_spec = pl.BlockSpec((1, bq, LANE), lambda n, qi, ki: (n, qi, 0))
+    k2_spec = pl.BlockSpec((1, bk, LANE), lambda n, qi, ki: (n, ki, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, Lk=Lk, block_k=bk),
+        grid=(N, Lq_pad // bq, Lk_pad // bk),
+        in_specs=[q2_spec, k2_spec, k2_spec, q2_spec, q2_spec, q2_spec],
+        out_specs=q2_spec,
+        out_shape=jax.ShapeDtypeStruct((N, Lq_pad, LANE), q.dtype),
+        scratch_shapes=[_vmem((bq, LANE))],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, o, do, lse)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public entry: custom-vjp flash attention over [B, L, H, D]
+# --------------------------------------------------------------------------
+
+def _pad_qkv(x: jnp.ndarray, L_pad: int) -> jnp.ndarray:
+    """[B, L, H, D] -> [B*H, L_pad, LANE]."""
+    B, L, H, D = x.shape
+    x = jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)
+    return jnp.pad(x, ((0, 0), (0, L_pad - L), (0, LANE - D)))
+
+
+def _unpad(x: jnp.ndarray, B: int, H: int, L: int, D: int) -> jnp.ndarray:
+    """[B*H, L_pad, LANE] -> [B, L, H, D]."""
+    x = x[:, :L, :D].reshape(B, H, L, D)
+    return jnp.moveaxis(x, 1, 2)
+
+
+def _run_fwd(q, k, v, scale: float, interpret: bool, save_lse: bool):
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    bq, bk, Lq_pad, Lk_pad = _block_sizes(Lq, Lk)
+    qp, kp, vp = (_pad_qkv(q, Lq_pad), _pad_qkv(k, Lk_pad),
+                  _pad_qkv(v, Lk_pad))
+    o, lse = _fwd_call(qp, kp, vp, scale=scale, Lq=Lq, Lk=Lk,
+                       interpret=interpret, save_lse=save_lse)
+    return _unpad(o, B, H, Lq, D), (qp, kp, vp, o, lse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale: float, interpret: bool):
+    # Primal (inference) path: no residuals materialised.
+    return _run_fwd(q, k, v, scale, interpret, save_lse=False)[0]
+
+
+def _flash_fwd(q, k, v, scale: float, interpret: bool):
+    out, (qp, kp, vp, o, lse) = _run_fwd(q, k, v, scale, interpret,
+                                         save_lse=True)
+    B, Lq, H, D = q.shape
+    return out, (qp, kp, vp, o, lse, (B, H, Lq, k.shape[1], D))
+
+
+def _flash_bwd(scale, interpret, res, g):
+    qp, kp, vp, o, lse, (B, H, Lq, Lk, D) = res
+    Lq_pad = qp.shape[1]
+    dop = _pad_qkv(g, Lq_pad)
+    dq, dk, dv = _bwd_call(qp, kp, vp, o, lse, dop, scale=scale, Lq=Lq,
+                           Lk=Lk, interpret=interpret)
+    return (_unpad(dq, B, H, Lq, D), _unpad(dk, B, H, Lk, D),
+            _unpad(dv, B, H, Lk, D))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    scale: Optional[float] = None,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention over ``[B, L, H, D]`` (jax.nn layout).
+
+    ``scale`` defaults to ``1/sqrt(D)`` (matching
+    ``jax.nn.dot_product_attention``).  ``interpret`` defaults to True off
+    TPU so the same kernel runs everywhere (tests exercise the exact tile
+    program the TPU executes).
+    """
+    assert supports(q, k, v), (q.shape, k.shape, v.shape, q.dtype)
+    if scale is None:
+        scale = float(1.0 / np.sqrt(q.shape[-1]))
+    if interpret is None:
+        try:
+            interpret = jax.devices()[0].platform != "tpu"
+        except RuntimeError:  # pragma: no cover
+            interpret = True
+    return _flash(q, k, v, scale, bool(interpret))

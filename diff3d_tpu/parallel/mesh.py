@@ -52,13 +52,20 @@ class MeshEnv:
 
     def params(self, pytree) -> object:
         """Sharding pytree for params/opt-state per the config policy."""
-        if self.cfg.param_sharding == "replicated":
+        mode = self.cfg.param_sharding
+        if mode == "replicated":
             return jax.tree.map(lambda _: self.replicated(), pytree)
-        if self.cfg.param_sharding == "fsdp":
+        if mode == "fsdp":
             return jax.tree.map(
                 lambda x: param_sharding(self.mesh, np.shape(x),
                                          self.cfg.data_axis), pytree)
-        raise ValueError(self.cfg.param_sharding)
+        if mode in ("tp", "fsdp+tp"):
+            fsdp_axis = self.cfg.data_axis if mode == "fsdp+tp" else None
+            return jax.tree_util.tree_map_with_path(
+                lambda path, x: tp_param_sharding(
+                    self.mesh, path, np.shape(x), self.cfg.model_axis,
+                    fsdp_axis=fsdp_axis), pytree)
+        raise ValueError(mode)
 
 
 def make_mesh(cfg: MeshConfig = MeshConfig(),
@@ -91,6 +98,59 @@ def batch_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def tp_param_sharding(mesh: Mesh, path, shape: Sequence[int],
+                      model_axis: str = "model",
+                      fsdp_axis: Optional[str] = None) -> NamedSharding:
+    """Megatron-style tensor-parallel spec for one X-UNet param leaf.
+
+    GSPMD turns these seed shardings into the classic TP comm pattern at
+    compile time (column-parallel q/k/v needs no collective; the row-
+    parallel out-proj matmul reduces partial sums over the model axis):
+
+      * attention ``q/k/v_proj`` kernels ``[C, C]`` — output dim over
+        ``model`` (column parallel); their biases likewise.
+      * attention ``out_proj`` kernel — input dim over ``model`` (row
+        parallel); bias replicated.
+      * conv kernels ``[kh, kw, cin, cout]`` and Dense kernels (FiLM,
+        logsnr MLP) — output channels over ``model``; biases likewise.
+      * everything else (norm scales, learned pose embeddings, tiny
+        leaves) — replicated.
+
+    Dims not divisible by the axis size fall back to replication.  With
+    ``fsdp_axis`` set, the largest still-unsharded divisible dim is
+    additionally sharded over it (ZeRO-style weight sharding on top of TP).
+    """
+    names = [getattr(p, "key", str(p)) for p in path]
+    tp = mesh.shape[model_axis]
+    spec: list = [None] * len(shape)
+
+    def shardable(dim: int) -> bool:
+        return len(shape) > dim and shape[dim] % tp == 0 and shape[dim] >= tp
+
+    is_kernel = names and names[-1] == "kernel"
+    if tp > 1 and is_kernel:
+        if any(n in ("q_proj", "k_proj", "v_proj") for n in names):
+            if shardable(len(shape) - 1):
+                spec[-1] = model_axis
+        elif "out_proj" in names:
+            if shardable(0):
+                spec[0] = model_axis
+        elif shardable(len(shape) - 1) and shape[-1] > 4:
+            spec[-1] = model_axis          # conv/Dense output channels
+    elif tp > 1 and names and names[-1] == "bias":
+        if "out_proj" not in names and shardable(0) and shape[0] > 4:
+            spec[0] = model_axis
+
+    if fsdp_axis is not None:
+        n = mesh.shape[fsdp_axis]
+        free = [i for i, s in enumerate(shape)
+                if spec[i] is None and s % n == 0 and s >= n]
+        if free and int(np.prod(shape)) >= n * 128:
+            axis = max(free, key=lambda i: shape[i])
+            spec[axis] = fsdp_axis
+    return NamedSharding(mesh, P(*spec))
 
 
 def param_sharding(mesh: Mesh, shape: Sequence[int],

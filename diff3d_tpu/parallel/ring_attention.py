@@ -1,0 +1,107 @@
+"""Sequence/context parallelism: ring attention + all-to-all (Ulysses).
+
+The reference never shards its attention sequence (``/root/reference/
+xunet.py:199-208`` runs full ``H*W``-token attention per device; SURVEY.md
+§5.7) — long-context scaling is a capability the TPU framework adds.  Two
+standard schemes, both pure-JAX collectives so XLA schedules them on ICI:
+
+* :func:`ring_sdpa` — blockwise (flash-style) attention with the KV shard
+  rotating around the mesh axis via ``lax.ppermute``; each of the
+  ``n_shards`` steps combines a local [L/n x L/n] attention block into
+  running (max, sum, acc) online-softmax state.  Memory per device is
+  O(L/n), compute overlaps with the ring transfer.
+* :func:`ulysses_sdpa` — ``all_to_all`` reshards tokens->heads so each
+  device holds ALL tokens for H/n heads, runs an ordinary (flash) sdpa,
+  and reshards back.  Cheaper for moderate L when heads divide evenly.
+
+Both are drop-in sdpa cores over local shards ``[B, L/n, H, D]`` of a
+global ``[B, L, H, D]`` array inside ``shard_map``; exactness vs unsharded
+attention is covered by tests on the 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_stats(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                 scale: float):
+    """One KV-block attention: returns (m, l, acc) with
+    m/l ``[B, Lq, H]`` and acc ``[B, Lq, H, D]`` (un-normalised PV)."""
+    s = jnp.einsum("blhd,bmhd->blhm", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("blhm,bmhd->blhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return m, l, acc
+
+
+def ring_sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              axis_name: str, scale: Optional[float] = None) -> jnp.ndarray:
+    """Ring attention over a sharded token axis.
+
+    Args:
+      q, k, v: local shards ``[B, L/n, H, D]`` (token axis sharded over
+        ``axis_name``); every query attends to every global key.
+      axis_name: the mesh axis the sequence is sharded over.
+
+    Returns the local output shard ``[B, L/n, H, D]``.
+    """
+    n = jax.lax.psum(1, axis_name)
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m0, l0, acc0 = _block_stats(q, k, v, scale)
+
+    def step(carry, _):
+        m, l, acc, k, v = carry
+        # rotate KV to the next device while (logically) computing; XLA
+        # overlaps the ppermute with the einsums where profitable.
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        bm, bl, bacc = _block_stats(q, k, v, scale)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l = l * alpha + bl * beta
+        acc = acc * alpha[..., None] + bacc * beta[..., None]
+        return (m_new, l, acc, k, v), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v), None, length=n - 1)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ulysses_sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                 axis_name: str,
+                 scale: Optional[float] = None) -> jnp.ndarray:
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Reshards ``[B, L/n, H, D]`` -> ``[B, L, H/n, D]``, runs full-sequence
+    attention on the local head subset, reshards back.  Requires
+    ``H % n == 0``.
+    """
+    n = jax.lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(f"heads {H} not divisible by axis size {n}")
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+
+    def scatter_heads(x):  # [B, L/n, H, D] -> [B, L, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def gather_heads(x):   # [B, L, H/n, D] -> [B, L/n, H, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out = jax.nn.dot_product_attention(qg, kg, vg, scale=scale)
+    return gather_heads(out)

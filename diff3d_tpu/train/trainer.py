@@ -35,7 +35,9 @@ log = logging.getLogger(__name__)
 
 
 def init_params(model: XUNet, cfg: Config, rng: jax.Array):
-    """Initialise params with a dummy batch (shapes only)."""
+    """Initialise params with a dummy batch (shapes only).  Compiled —
+    eager flax init dispatches thousands of tiny device ops, which is
+    minutes over a tunneled TPU."""
     H, W = cfg.model.H, cfg.model.W
     batch = {
         "x": jnp.zeros((1, H, W, 3)),
@@ -45,8 +47,10 @@ def init_params(model: XUNet, cfg: Config, rng: jax.Array):
         "t": jnp.zeros((1, 2, 3)),
         "K": jnp.broadcast_to(jnp.eye(3), (1, 3, 3)),
     }
-    return model.init({"params": rng}, batch,
-                      cond_mask=jnp.ones((1,), bool))["params"]
+    return jax.jit(
+        lambda r: model.init({"params": r}, batch,
+                             cond_mask=jnp.ones((1,), bool))
+    )(rng)["params"]
 
 
 class Trainer:
@@ -107,7 +111,20 @@ class Trainer:
         with open(self._metrics_path, "a") as f:
             f.write(json.dumps(record) + "\n")
 
-    def train(self, max_steps: Optional[int] = None) -> TrainState:
+    def train(self, max_steps: Optional[int] = None,
+              profile_steps: Optional[tuple] = None) -> TrainState:
+        """Run the training loop.
+
+        ``profile_steps=(start, stop)`` captures a ``jax.profiler`` device
+        trace of those steps into ``<workdir>/profile`` (start after the
+        first step so the compile isn't traced).
+
+        Failure handling the reference lacks (SURVEY.md §5.3): a non-finite
+        loss halts with a checkpoint-preserving ``FloatingPointError``
+        instead of silently training on garbage, and any exception inside
+        the loop triggers a best-effort emergency checkpoint so ``transfer=
+        True`` (the reference's ``--transfer``) resumes at the last step.
+        """
         if self.loader is None:
             raise ValueError("attach a loader before train()")
         cfg = self.cfg.train
@@ -117,35 +134,74 @@ class Trainer:
         # jitted step runs async; we only block at log boundaries).
         step = int(self.state.step)
         window_start, window_t = step, t0
+        profiling = False
 
-        while step < max_steps:
-            batch = next(self.loader)
-            batch = {"imgs": batch["imgs"], "R": batch["R"],
-                     "T": batch["T"], "K": batch["K"]}
-            self.state, metrics = self.step_fn(self.state, batch, self.rng)
-            step += 1
+        try:
+            while step < max_steps:
+                if profile_steps and step == profile_steps[0]:
+                    jax.profiler.start_trace(
+                        os.path.join(self.workdir, "profile"))
+                    profiling = True
 
-            if step % cfg.log_every == 0 or step >= max_steps:
-                jax.block_until_ready(metrics["loss"])
-                now = time.monotonic()
-                dt = max(now - window_t, 1e-9)
-                sps = (step - window_start) / dt
-                window_start, window_t = step, now
-                rec = {
-                    "step": step,
-                    "loss": float(metrics["loss"]),
-                    "lr": float(metrics["lr"]),
-                    "grad_norm": float(metrics["grad_norm"]),
-                    "steps_per_sec": sps,
-                    "examples_per_sec": sps * cfg.global_batch,
-                    "wall_s": now - t0,
-                }
-                self._log(rec)
-                log.info("step %d loss %.4f (%.2f steps/s)",
-                         step, rec["loss"], sps)
+                batch = next(self.loader)
+                batch = {"imgs": batch["imgs"], "R": batch["R"],
+                         "T": batch["T"], "K": batch["K"]}
+                self.state, metrics = self.step_fn(self.state, batch,
+                                                   self.rng)
+                step += 1
 
-            if step % cfg.ckpt_every == 0 or step >= max_steps:
-                self.ckpt.save(self.state)
+                if profiling and step >= profile_steps[1]:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling = False
+
+                if step % cfg.log_every == 0 or step >= max_steps:
+                    jax.block_until_ready(metrics["loss"])
+                    now = time.monotonic()
+                    dt = max(now - window_t, 1e-9)
+                    sps = (step - window_start) / dt
+                    window_start, window_t = step, now
+                    loss = float(metrics["loss"])
+                    rec = {
+                        "step": step,
+                        "loss": loss,
+                        "lr": float(metrics["lr"]),
+                        "grad_norm": float(metrics["grad_norm"]),
+                        "steps_per_sec": sps,
+                        "examples_per_sec": sps * cfg.global_batch,
+                        "wall_s": now - t0,
+                    }
+                    self._log(rec)
+                    log.info("step %d loss %.4f (%.2f steps/s)",
+                             step, rec["loss"], sps)
+                    if not np.isfinite(loss):
+                        raise FloatingPointError(
+                            f"non-finite loss {loss} at step {step}; "
+                            "last finite checkpoint preserved")
+
+                if step % cfg.ckpt_every == 0 or step >= max_steps:
+                    # Never persist a poisoned state: ckpt cadence need not
+                    # align with log cadence, so check the step's loss here
+                    # too before it becomes the latest checkpoint.
+                    loss = float(metrics["loss"])
+                    if not np.isfinite(loss):
+                        raise FloatingPointError(
+                            f"non-finite loss {loss} at step {step}; "
+                            "last finite checkpoint preserved")
+                    self.ckpt.save(self.state)
+        except FloatingPointError:
+            raise
+        except BaseException:
+            # Preemption / OOM / data error: keep the last good state so a
+            # restart with transfer=True loses at most ckpt_every steps.
+            try:
+                self.ckpt.save(self.state, force=True)
+            except Exception:  # pragma: no cover - best effort
+                log.exception("emergency checkpoint failed")
+            raise
+        finally:
+            if profiling:  # pragma: no cover - only on mid-window exit
+                jax.profiler.stop_trace()
 
         self.ckpt.wait()
         return self.state

@@ -1,0 +1,3 @@
+from diff3d_tpu.utils.profiling import StepTimer, profile_window
+
+__all__ = ["StepTimer", "profile_window"]

@@ -1,0 +1,83 @@
+"""Tracing / profiling utilities.
+
+The reference has no observability at all — two dead ``strt=time.time()``
+assignments and tqdm bars (``/root/reference/train.py:136,150,264``;
+SURVEY.md §5.1).  Here:
+
+  * :func:`profile_window` — capture a ``jax.profiler`` device trace for a
+    window of steps, viewable in TensorBoard/Perfetto (the TPU-world
+    nsight/torch-profiler equivalent).
+  * :class:`StepTimer` — cheap wall-clock step timing with percentile
+    summaries, no device syncs outside window boundaries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator, List, Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def profile_window(logdir: str, enabled: bool = True) -> Iterator[None]:
+    """Trace everything inside the ``with`` body to ``logdir``.
+
+    Use around a few already-compiled steps (never the first — tracing a
+    compile produces a useless giant trace)::
+
+        with profile_window(os.path.join(workdir, "profile")):
+            for _ in range(3):
+                state, metrics = step_fn(state, batch, rng)
+            jax.block_until_ready(metrics["loss"])
+    """
+    if not enabled:
+        yield
+        return
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock per-step timing.
+
+    ``tick()`` marks a step boundary; ``summary()`` reports mean / p50 /
+    p95 / max milliseconds over the retained window.  Pure host-side —
+    call ``jax.block_until_ready`` yourself at window edges if you want
+    device-inclusive times (the trainer does, at log boundaries).
+    """
+
+    def __init__(self, window: int = 512):
+        self._window = window
+        self._times: List[float] = []
+        self._last: Optional[float] = None
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+            if len(self._times) > self._window:
+                self._times = self._times[-self._window:]
+        self._last = now
+
+    def reset(self) -> None:
+        self._times.clear()
+        self._last = None
+
+    def summary(self) -> dict:
+        if not self._times:
+            return {}
+        ms = np.asarray(self._times) * 1e3
+        return {
+            "step_ms_mean": float(ms.mean()),
+            "step_ms_p50": float(np.percentile(ms, 50)),
+            "step_ms_p95": float(np.percentile(ms, 95)),
+            "step_ms_max": float(ms.max()),
+        }

@@ -1,0 +1,96 @@
+"""Pallas flash-attention kernel vs the XLA reference.
+
+Runs the exact TPU tile program in Pallas interpret mode on CPU (the
+tests' virtual-device platform), checking forward and backward against
+``jax.nn.dot_product_attention`` over the shapes the X-UNet actually uses
+(token counts 64..1024, head dims 32..128, including the padded /
+non-square cases).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from diff3d_tpu.ops.attention import multi_head_attention, sdpa
+from diff3d_tpu.ops.pallas_attention import flash_attention, supports
+
+SHAPES = [
+    # (B, Lq, Lk, H, D): xunet attention shapes (SURVEY.md §3.4) + padding
+    (2, 64, 64, 4, 64),      # 8x8 tokens, 256ch/4heads
+    (2, 256, 256, 4, 128),   # 16x16 tokens, 512ch/4heads
+    (1, 200, 200, 2, 32),    # non-multiple-of-128 seq (padded)
+    (1, 96, 160, 2, 64),     # cross attention, Lq != Lk
+]
+
+
+def _qkv(shape, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    B, Lq, Lk, H, D = shape
+    q = jnp.asarray(rng.randn(B, Lq, H, D), dtype)
+    k = jnp.asarray(rng.randn(B, Lk, H, D), dtype)
+    v = jnp.asarray(rng.randn(B, Lk, H, D), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_forward_matches_xla(shape):
+    q, k, v = _qkv(shape)
+    ref = jax.nn.dot_product_attention(q, k, v)
+    out = flash_attention(q, k, v, interpret=True)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
+
+
+@pytest.mark.parametrize("shape", SHAPES[:2])
+def test_backward_matches_xla(shape):
+    q, k, v = _qkv(shape)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_ref = jax.grad(loss(jax.nn.dot_product_attention),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, interpret=True)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(b, a, atol=5e-2, rtol=5e-2)
+
+
+def test_bf16_forward():
+    q, k, v = _qkv((2, 128, 128, 4, 64), dtype=jnp.bfloat16)
+    ref = jax.nn.dot_product_attention(q, k, v)
+    out = flash_attention(q, k, v, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(out.astype(np.float32),
+                               ref.astype(np.float32), atol=5e-2, rtol=5e-2)
+
+
+def test_supports_gating():
+    q, k, v = _qkv((1, 64, 64, 2, 64))
+    assert supports(q, k, v)
+    # head dim beyond one lane tile is rejected -> dispatcher falls back
+    big = jnp.zeros((1, 64, 2, 256))
+    assert not supports(big, big, big)
+    assert not supports(q.astype(jnp.float16), k, v)
+
+
+def test_dispatcher_jit_consistency():
+    """sdpa under jit: pallas and xla backends agree."""
+    q, k, v = _qkv((2, 64, 64, 4, 64))
+
+    @jax.jit
+    def f(q, k, v):
+        return sdpa(q, k, v, impl="xla")
+
+    ref = f(q, k, v)
+    out = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, interpret=True))(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
+
+
+def test_multi_head_attention_wrapper():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 64, 128), jnp.float32)
+    out = multi_head_attention(x, x, x, num_heads=4, impl="xla")
+    assert out.shape == (2, 64, 128)

@@ -69,3 +69,107 @@ def test_psum_over_mesh_matches_sum():
             in_specs=P("data"), out_specs=P())(x)
 
     np.testing.assert_allclose(np.asarray(allreduce(x)), 28.0)
+
+
+def _tiny_model_and_batch():
+    from diff3d_tpu.config import test_config
+    from diff3d_tpu.models import XUNet
+
+    cfg = test_config(imgsize=16, ch=8)
+    model = XUNet(cfg.model)
+    B = 4
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": jnp.asarray(rng.randn(B, 16, 16, 3), jnp.float32),
+        "z": jnp.asarray(rng.randn(B, 16, 16, 3), jnp.float32),
+        "logsnr": jnp.asarray(np.stack([np.full(B, 20.0),
+                                        rng.uniform(-20, 20, B)], 1),
+                              jnp.float32),
+        "R": jnp.broadcast_to(jnp.eye(3), (B, 2, 3, 3)),
+        "t": jnp.asarray(rng.randn(B, 2, 3), jnp.float32),
+        "K": jnp.broadcast_to(
+            jnp.array([[20.0, 0, 8.0], [0, 20.0, 8.0], [0, 0, 1]]),
+            (B, 3, 3)),
+    }
+    cond = jnp.ones((B,), bool)
+    params = model.init(jax.random.PRNGKey(0), batch,
+                        cond_mask=cond)["params"]
+    # nudge zero-init convs so TP-vs-replicated comparison is informative
+    params = jax.tree.map(lambda x: x + 0.01, params)
+    return model, params, batch, cond
+
+
+def test_tp_param_rules():
+    from diff3d_tpu.config import MeshConfig
+
+    env = make_mesh(MeshConfig(model_parallel=4, param_sharding="tp"))
+    model, params, _, _ = _tiny_model_and_batch()
+    shardings = env.params(params)
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+
+    def spec_for(substr):
+        return [s.spec for path, s in flat
+                if substr in "/".join(getattr(p, "key", str(p))
+                                      for p in path)]
+
+    # column-parallel q/k/v, row-parallel out_proj
+    assert any(sp[-1] == "model" for sp in spec_for("q_proj/kernel") if sp)
+    assert any(sp and sp[0] == "model" for sp in spec_for("out_proj/kernel"))
+
+
+def test_tp_forward_matches_replicated():
+    """GSPMD-partitioned (model_parallel=4) forward == single-device."""
+    from diff3d_tpu.config import MeshConfig
+
+    model, params, batch, cond = _tiny_model_and_batch()
+    ref = model.apply({"params": params}, batch, cond_mask=cond)
+
+    env = make_mesh(MeshConfig(data_parallel=2, model_parallel=4,
+                               param_sharding="tp"))
+    p_sh = jax.device_put(params, env.params(params))
+    b_sh = jax.device_put(batch, env.batch())
+    cond_sh = jax.device_put(cond, env.batch())
+
+    @jax.jit
+    def fwd(params, batch, cond):
+        return model.apply({"params": params}, batch, cond_mask=cond)
+
+    out = fwd(p_sh, b_sh, cond_sh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_fsdp_tp_train_step_runs():
+    import dataclasses
+
+    from diff3d_tpu.config import MeshConfig, test_config
+    from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.train import (TrainState, create_train_state,
+                                  make_train_step)
+    from diff3d_tpu.train.trainer import init_params
+
+    cfg = test_config(imgsize=16, ch=8)
+    cfg = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(cfg.train, global_batch=4),
+        mesh=MeshConfig(data_parallel=2, model_parallel=4,
+                        param_sharding="fsdp+tp"))
+    env = make_mesh(cfg.mesh)
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(init_params(model, cfg, rng), cfg.train)
+    state = jax.device_put(
+        state, TrainState(step=env.replicated(),
+                          params=env.params(state.params),
+                          opt_state=env.params(state.opt_state),
+                          ema_params=env.params(state.ema_params)))
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=16)
+    raw = next(InfiniteLoader(ds, 4, num_workers=0))
+    batch = jax.device_put(
+        {"imgs": raw["imgs"], "R": raw["R"], "T": raw["T"], "K": raw["K"]},
+        env.batch())
+    step_fn = make_train_step(model, cfg, env)
+    state, metrics = step_fn(state, batch, rng)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 1

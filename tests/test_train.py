@@ -182,3 +182,69 @@ def test_config_validate_rejects_clip_schedule_mismatch():
         cfg, diffusion=dataclasses.replace(cfg.diffusion, logsnr_max=15.0))
     with pytest.raises(ValueError, match="logsnr_clip"):
         bad.validate()
+
+
+def test_step_timer_and_profile_window(tmp_path):
+    import time
+
+    from diff3d_tpu.utils import StepTimer, profile_window
+
+    t = StepTimer()
+    assert t.summary() == {}
+    for _ in range(4):
+        t.tick()
+        time.sleep(0.002)
+    s = t.summary()
+    assert s["step_ms_mean"] >= 1.0
+    assert s["step_ms_p95"] >= s["step_ms_p50"]
+
+    # disabled window is a no-op; enabled window writes a trace dir
+    with profile_window(str(tmp_path / "prof_off"), enabled=False):
+        pass
+    assert not os.path.exists(tmp_path / "prof_off")
+    with profile_window(str(tmp_path / "prof")):
+        jnp.zeros(8).block_until_ready()
+    assert os.path.isdir(tmp_path / "prof")
+
+
+def test_trainer_halts_on_nonfinite_loss(tmp_path):
+    cfg = tiny_cfg(max_steps=2, ckpt_every=10, log_every=1)
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=cfg.model.H)
+
+    class PoisonLoader:
+        def __init__(self):
+            self._it = InfiniteLoader(ds, cfg.train.global_batch, seed=0,
+                                      num_workers=0)
+
+        def __next__(self):
+            b = next(self._it)
+            b["imgs"] = b["imgs"] * np.nan
+            return b
+
+    tr = Trainer(cfg, PoisonLoader(), workdir=str(tmp_path))
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        tr.train()
+
+
+def test_trainer_emergency_checkpoint_on_crash(tmp_path):
+    cfg = tiny_cfg(max_steps=5, ckpt_every=100, log_every=100)
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=cfg.model.H)
+
+    class CrashLoader:
+        def __init__(self):
+            self.n = 0
+            self._it = InfiniteLoader(ds, cfg.train.global_batch, seed=0,
+                                      num_workers=0)
+
+        def __next__(self):
+            self.n += 1
+            if self.n > 2:
+                raise KeyboardInterrupt  # simulated preemption
+            return next(self._it)
+
+    tr = Trainer(cfg, CrashLoader(), workdir=str(tmp_path))
+    with pytest.raises(KeyboardInterrupt):
+        tr.train()
+    tr.ckpt.wait()
+    # the 2 completed steps were preserved by the emergency save
+    assert tr.ckpt.latest_step() == 2
