@@ -26,7 +26,7 @@ BASELINE_STEPS_PER_SEC = 101_000 / (120 * 3600)   # 8x3090, README.md:39
 BASELINE_EXAMPLES_PER_SEC = BASELINE_STEPS_PER_SEC * 128
 
 
-def _run(global_batch: int, n_steps: int):
+def _run(global_batch: int, n_steps: int, accum: int = 1):
     import jax
 
     from diff3d_tpu.config import srn64_config
@@ -40,7 +40,8 @@ def _run(global_batch: int, n_steps: int):
     cfg = dataclasses.replace(
         cfg,
         model=dataclasses.replace(cfg.model, remat=True),
-        train=dataclasses.replace(cfg.train, global_batch=global_batch))
+        train=dataclasses.replace(cfg.train, global_batch=global_batch,
+                                  accum_steps=accum))
 
     env = make_mesh(cfg.mesh)
     model = XUNet(cfg.model)
@@ -82,29 +83,41 @@ def main() -> None:
         pass
 
     platform = jax.devices()[0].platform
-    # CPU fallback (no accelerator attached): tiny so the bench finishes.
-    batches = [128, 64, 32] if platform != "cpu" else [8]
+    # Configs in preference order: the reference's exact global batch 128
+    # (2 accumulation microbatches fit one 16G chip), then direct smaller
+    # batches.  CPU fallback (no accelerator): tiny so the bench finishes.
+    configs = ([(128, 2), (64, 1), (32, 1)] if platform != "cpu"
+               else [(8, 1)])
     n_steps = 10 if platform != "cpu" else 3
 
-    steps_per_sec, global_batch, err = None, None, None
-    for global_batch in batches:
+    steps_per_sec, global_batch, accum, err = None, None, 1, None
+    for global_batch, accum in configs:
         try:
-            steps_per_sec = _run(global_batch, n_steps)
+            steps_per_sec = _run(global_batch, n_steps, accum)
             break
-        except Exception as e:  # XlaRuntimeError (OOM) etc.
-            if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(
-                    e).lower():
+        except Exception as e:
+            # OOM (RESOURCE_EXHAUSTED) or the remote-compile helper dying
+            # on a too-big program both mean "try the next config"; other
+            # INTERNAL errors are real failures and propagate.
+            msg = str(e)
+            compile_helper_died = ("remote_compile" in msg
+                                   or "tpu_compile" in msg)
+            if ("RESOURCE_EXHAUSTED" not in msg and "memory" not in
+                    msg.lower() and not compile_helper_died):
                 raise
             # Keep only the message: holding the exception would pin the
             # failed attempt's traceback frames (train state, batch) and
             # their HBM buffers across the retry.
-            err = str(e).splitlines()[0]
+            err = msg.splitlines()[0]
+            print(f"bench: b{global_batch}x{accum} failed ({err}); "
+                  "trying next config", file=sys.stderr)
     if steps_per_sec is None:
         raise SystemExit(f"bench failed at every batch size: {err}")
 
     examples_per_sec = steps_per_sec * global_batch
+    name = f"b{global_batch}" + (f"x{accum}accum" if accum > 1 else "")
     print(json.dumps({
-        "metric": f"train_examples_per_sec_srn64_b{global_batch}_{platform}"
+        "metric": f"train_examples_per_sec_srn64_{name}_{platform}"
                   f"_x{len(jax.devices())}",
         "value": round(examples_per_sec, 2),
         "unit": "examples/s",

@@ -1,0 +1,135 @@
+"""Evaluation entry point: PSNR / SSIM / FID of synthesised novel views.
+
+The reference has NO evaluation code (``SURVEY.md`` §5.5) despite FID/PSNR
+being the paper's headline metrics; this closes that gap.  For each of the
+first ``--objects`` val-split objects, the trained model synthesises every
+view autoregressively from view 0 (the reference sampler's protocol,
+``/root/reference/sampling.py:158-184``), and the generated views are
+scored against ground truth:
+
+  * PSNR / SSIM per view at the sampler's guidance weight ``--w_index``
+    (default 1, i.e. w=1 in the reference's 0..7 sweep), averaged.
+  * FID between the pooled generated views and the pooled GT views
+    (random-feature extractor by default; pass true Inception features
+    via the library API for paper-grade numbers).
+
+Writes one JSON line to stdout and (optionally) ``--out`` JSONL.
+
+Usage:
+    python -m diff3d_tpu.cli.eval_cli --model ./checkpoints \
+        --val_data ./data/SRN/cars_train [--objects 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", required=True,
+                   help="checkpoint directory (Orbax root)")
+    p.add_argument("--val_data", required=True,
+                   help="SRN split dir (val objects are drawn from the "
+                        "same 90/10 split the trainer used)")
+    p.add_argument("--picklefile", default=None)
+    p.add_argument("--config", choices=["srn64", "srn128", "test"],
+                   default="srn64")
+    p.add_argument("--objects", type=int, default=8,
+                   help="number of val objects to evaluate")
+    p.add_argument("--max_views", type=int, default=None,
+                   help="cap views per object (full object if omitted)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="diffusion steps (reference: 256)")
+    p.add_argument("--w_index", type=int, default=1,
+                   help="guidance-sweep index scored for PSNR/SSIM/FID")
+    p.add_argument("--raw_params", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None, help="append JSONL here")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    logging.getLogger("absl").setLevel(logging.WARNING)
+
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from diff3d_tpu import config as config_lib
+    from diff3d_tpu.data.srn import SRNDataset
+    from diff3d_tpu.evaluation import (fid_from_stats, gaussian_stats, psnr,
+                                       ssim)
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.sampling import Sampler
+    from diff3d_tpu.train import CheckpointManager, create_train_state
+    from diff3d_tpu.train.trainer import init_params
+
+    cfg = {"srn64": config_lib.srn64_config,
+           "srn128": config_lib.srn128_config,
+           "test": config_lib.test_config}[args.config]()
+    if args.steps:
+        cfg = dataclasses.replace(
+            cfg, diffusion=dataclasses.replace(cfg.diffusion,
+                                               timesteps=args.steps))
+
+    model = XUNet(cfg.model)
+    state = create_train_state(
+        init_params(model, cfg, jax.random.PRNGKey(0)), cfg.train)
+    mgr = CheckpointManager(args.model)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+    restored = mgr.restore(abstract)
+    if restored is None:
+        raise FileNotFoundError(f"no checkpoint under {args.model}")
+    params = restored.params if args.raw_params else restored.ema_params
+    step = int(restored.step)
+
+    ds = SRNDataset("val", args.val_data, args.picklefile,
+                    imgsize=cfg.model.H,
+                    split_seed=cfg.data.split_seed,
+                    train_fraction=cfg.data.train_fraction)
+    sampler = Sampler(model, params, cfg)
+
+    rng = jax.random.PRNGKey(args.seed)
+    psnrs, ssims, gen_views, gt_views = [], [], [], []
+    for obj in ds.ids[: args.objects]:
+        views = ds.all_views(obj)
+        rng, k = jax.random.split(rng)
+        out = sampler.synthesize(views, k, max_views=args.max_views)
+        if out.shape[0] == 0:
+            continue
+        gen = out[:, args.w_index]                 # [V-1, H, W, 3]
+        gt = views["imgs"][1: 1 + gen.shape[0]]
+        psnrs.extend(np.asarray(psnr(gen, gt)).tolist())
+        ssims.extend(np.asarray(ssim(gen, gt)).tolist())
+        gen_views.append(gen)
+        gt_views.append(gt)
+        logging.info("object %s: psnr %.2f", obj,
+                     float(np.mean(psnrs[-gen.shape[0]:])))
+
+    fid = fid_from_stats(gaussian_stats(gt_views),
+                         gaussian_stats(gen_views))
+    record = {
+        "checkpoint_step": step,
+        "objects": len(gen_views),
+        "views": len(psnrs),
+        "psnr": round(float(np.mean(psnrs)), 3),
+        "ssim": round(float(np.mean(ssims)), 4),
+        "fid_randfeat": round(float(fid), 3),
+        "w_index": args.w_index,
+        "timesteps": cfg.diffusion.timesteps,
+    }
+    print(json.dumps(record))
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+if __name__ == "__main__":
+    main()

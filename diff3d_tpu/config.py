@@ -84,6 +84,12 @@ class TrainConfig:
     ckpt_every: int = 50
     log_every: int = 50
     ema_halflife_examples: int = 500_000
+    # Gradient accumulation: each optimizer step scans over `accum_steps`
+    # microbatches of global_batch/accum_steps examples, averaging grads.
+    # Lets the reference's batch-128 config train on HBM that only holds
+    # batch-64 activations (no reference counterpart; their answer to OOM
+    # was "use a smaller image size", README.md:39).
+    accum_steps: int = 1
     seed: int = 0
     checkpoint_dir: str = "checkpoints"
     keep_checkpoints: int = 3
@@ -132,6 +138,10 @@ class Config:
 
     def validate(self) -> None:
         self.model.validate()
+        if self.train.global_batch % max(1, self.train.accum_steps):
+            raise ValueError(
+                f"global_batch ({self.train.global_batch}) must be "
+                f"divisible by accum_steps ({self.train.accum_steps})")
         if self.model.logsnr_clip != self.diffusion.logsnr_max:
             raise ValueError(
                 f"model.logsnr_clip ({self.model.logsnr_clip}) must equal "

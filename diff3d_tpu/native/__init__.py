@@ -36,12 +36,21 @@ _ERRORS = {1: "cannot open file", 2: "not a PNG", 3: "PNG decode error",
 
 
 def _build() -> bool:
+    # Compile to a per-pid temp path and os.rename into place: concurrent
+    # processes (multi-process jax.distributed, pytest-xdist) may race on
+    # a shared checkout, and rename is atomic while `g++ -o final` is not.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
-           "-o", _LIB, "-lpng", "-pthread"]
+           "-o", tmp, "-lpng", "-pthread"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

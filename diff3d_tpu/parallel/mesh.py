@@ -140,7 +140,14 @@ def tp_param_sharding(mesh: Mesh, path, shape: Sequence[int],
         elif shardable(len(shape) - 1) and shape[-1] > 4:
             spec[-1] = model_axis          # conv/Dense output channels
     elif tp > 1 and names and names[-1] == "bias":
-        if "out_proj" not in names and shardable(0) and shape[0] > 4:
+        # Only biases of column-parallel layers (q/k/v, convs, Dense):
+        # norm biases stay replicated with their (replicated) scales, and
+        # the row-parallel out_proj bias is added after the reduce.
+        parent = names[-2] if len(names) >= 2 else ""
+        col_parallel = (parent in ("q_proj", "k_proj", "v_proj")
+                        or "conv" in parent or parent.startswith("Dense")
+                        or parent == "skip_proj")
+        if col_parallel and shardable(0) and shape[0] > 4:
             spec[0] = model_axis
 
     if fsdp_axis is not None:

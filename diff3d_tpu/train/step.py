@@ -43,9 +43,9 @@ def make_train_step(model, cfg: Config, env: MeshEnv | None = None,
     ema_decay = ema_decay_per_step(cfg.train)
     dcfg = cfg.diffusion
 
-    def step_fn(state: TrainState, batch: Dict[str, jnp.ndarray],
-                rng: jax.Array) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
-        rng = jax.random.fold_in(rng, state.step)
+    accum = max(1, cfg.train.accum_steps)
+
+    def loss_and_grad(params, batch, rng):
         rng, k_drop = jax.random.split(rng)
 
         def loss_fn(params):
@@ -58,7 +58,35 @@ def make_train_step(model, cfg: Config, env: MeshEnv | None = None,
                 rng, cond_prob=dcfg.cond_prob, loss_type=dcfg.loss_type,
                 logsnr_min=dcfg.logsnr_min, logsnr_max=dcfg.logsnr_max)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return jax.value_and_grad(loss_fn)(params)
+
+    def step_fn(state: TrainState, batch: Dict[str, jnp.ndarray],
+                rng: jax.Array) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        rng = jax.random.fold_in(rng, state.step)
+
+        if accum == 1:
+            loss, grads = loss_and_grad(state.params, batch, rng)
+        else:
+            # Scan over `accum` microbatches; only one microbatch's
+            # activations are live at a time, grads averaged.
+            micro = jax.tree.map(
+                lambda x: x.reshape(accum, x.shape[0] // accum,
+                                    *x.shape[1:]), batch)
+
+            def body(carry, inp):
+                i, mb = inp
+                l, g = loss_and_grad(state.params, mb,
+                                     jax.random.fold_in(rng, i))
+                loss_acc, grads_acc = carry
+                return (loss_acc + l,
+                        jax.tree.map(jnp.add, grads_acc, g)), None
+
+            init = (jnp.zeros(()),
+                    jax.tree.map(jnp.zeros_like, state.params))
+            (loss, grads), _ = jax.lax.scan(
+                body, init, (jnp.arange(accum), micro))
+            loss = loss / accum
+            grads = jax.tree.map(lambda g: g / accum, grads)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         ema_params = jax.tree.map(

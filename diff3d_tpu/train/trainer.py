@@ -181,13 +181,18 @@ class Trainer:
 
                 if step % cfg.ckpt_every == 0 or step >= max_steps:
                     # Never persist a poisoned state: ckpt cadence need not
-                    # align with log cadence, so check the step's loss here
-                    # too before it becomes the latest checkpoint.
+                    # align with log cadence, so check this step's health
+                    # here too.  grad_norm covers the finite-loss /
+                    # non-finite-gradient case (the loss is computed from
+                    # pre-update params, so it can look fine while the
+                    # just-updated params are already NaN).
                     loss = float(metrics["loss"])
-                    if not np.isfinite(loss):
+                    gnorm = float(metrics["grad_norm"])
+                    if not (np.isfinite(loss) and np.isfinite(gnorm)):
                         raise FloatingPointError(
-                            f"non-finite loss {loss} at step {step}; "
-                            "last finite checkpoint preserved")
+                            f"non-finite loss {loss} / grad_norm {gnorm} "
+                            f"at step {step}; last finite checkpoint "
+                            "preserved")
                     self.ckpt.save(self.state)
         except FloatingPointError:
             raise

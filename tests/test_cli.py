@@ -57,3 +57,41 @@ def test_train_then_sample_cli_end_to_end(tmp_path):
                      "--steps", "4"])
     assert os.path.exists(os.path.join(out, "1", "gt.png"))
     assert os.path.exists(os.path.join(out, "1", "0.png"))
+
+
+def test_eval_cli_end_to_end(tmp_path, capsys):
+    """Train 2 steps, then score PSNR/SSIM/FID on a fake val object."""
+    from diff3d_tpu.cli import eval_cli
+
+    wd = str(tmp_path)
+    train_cli.main(["--synthetic", "--config", "test", "--steps", "2",
+                    "--batch", "8", "--workdir", wd, "--num_workers", "0"])
+    ckpt_root = os.path.join(wd, "checkpoints")
+
+    # fake SRN split dir with two objects x 3 views (val split non-empty
+    # needs train_fraction < 1; the default 0.9 keeps >= 1 of 10 in val)
+    from PIL import Image
+    rng = np.random.default_rng(1)
+    data_dir = tmp_path / "srn"
+    for o in range(10):
+        obj = data_dir / f"obj{o}"
+        for sub in ("rgb", "pose", "intrinsics"):
+            (obj / sub).mkdir(parents=True)
+        for v in range(3):
+            name = f"{v:06d}"
+            Image.fromarray(rng.integers(0, 255, (16, 16, 3),
+                                         dtype=np.uint8)).save(
+                obj / "rgb" / f"{name}.png")
+            pose = np.eye(4)
+            pose[:3, 3] = [2.0, 0.1 * v, 0.3]
+            np.savetxt(obj / "pose" / f"{name}.txt", pose.reshape(1, 16))
+            K = np.array([[19.0, 0, 8], [0, 19.0, 8], [0, 0, 1]])
+            np.savetxt(obj / "intrinsics" / f"{name}.txt", K.reshape(1, 9))
+
+    out_jsonl = str(tmp_path / "eval.jsonl")
+    eval_cli.main(["--model", ckpt_root, "--val_data", str(data_dir),
+                   "--config", "test", "--objects", "1", "--steps", "2",
+                   "--max_views", "3", "--out", out_jsonl])
+    rec = json.loads(open(out_jsonl).read().strip())
+    assert rec["views"] >= 2 and np.isfinite(rec["psnr"])
+    assert np.isfinite(rec["fid_randfeat"]) and -1 <= rec["ssim"] <= 1

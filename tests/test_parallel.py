@@ -173,3 +173,18 @@ def test_fsdp_tp_train_step_runs():
     state, metrics = step_fn(state, batch, rng)
     assert np.isfinite(float(metrics["loss"]))
     assert int(state.step) == 1
+
+
+def test_tp_norm_biases_stay_replicated():
+    from diff3d_tpu.config import MeshConfig
+    from jax.sharding import PartitionSpec as P
+
+    env = make_mesh(MeshConfig(model_parallel=4, param_sharding="tp"))
+    model, params, _, _ = _tiny_model_and_batch()
+    shardings = env.params(params)
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    gn_bias = [s.spec for path, s in flat
+               if "GroupNorm" in "/".join(getattr(p, "key", str(p))
+                                          for p in path)]
+    # replicated == every spec entry None (P() and P(None) both qualify)
+    assert gn_bias and all(all(a is None for a in sp) for sp in gn_bias)
