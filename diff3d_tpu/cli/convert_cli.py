@@ -1,0 +1,74 @@
+"""Convert a reference PyTorch checkpoint into this framework's format.
+
+Takes the reference's ``.pt`` files (``{'model': state_dict, 'optim': ...,
+'step': ...}`` — ``/root/reference/train.py:287-298``, incl. the published
+pretrained weights) and writes an Orbax checkpoint that ``train_cli
+--transfer``, ``sample_cli`` and ``eval_cli`` load directly.  The optimizer
+state is NOT converted (torch Adam moments don't map onto optax's tree);
+the step counter is preserved so schedules resume at the right point, and
+the EMA is seeded from the converted weights (the reference never
+implemented its documented EMA, SURVEY.md §2.3).
+
+Usage:
+    python -m diff3d_tpu.cli.convert_cli --torch_ckpt latest.pt \
+        --out ./checkpoints [--config srn64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--torch_ckpt", required=True, help="reference .pt file")
+    p.add_argument("--out", required=True,
+                   help="Orbax checkpoint root to write")
+    p.add_argument("--config", choices=["srn64", "srn128", "test"],
+                   default="srn64")
+    p.add_argument("--step", type=int, default=None,
+                   help="override the step recorded in the checkpoint")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    logging.getLogger("absl").setLevel(logging.WARNING)
+
+    import jax
+    import jax.numpy as jnp
+
+    from diff3d_tpu import config as config_lib
+    from diff3d_tpu.convert import load_torch_checkpoint
+    from diff3d_tpu.train import CheckpointManager, create_train_state
+    from diff3d_tpu.train.state import advance_schedule
+
+    cfg = {"srn64": config_lib.srn64_config,
+           "srn128": config_lib.srn128_config,
+           "test": config_lib.test_config}[args.config]()
+
+    params, ckpt_step = load_torch_checkpoint(args.torch_ckpt, cfg.model)
+    step = args.step if args.step is not None else ckpt_step
+
+    params = jax.tree.map(jnp.asarray, params)
+    state = create_train_state(params, cfg.train)
+    # The lr schedule's position is optax's internal count, not
+    # TrainState.step — advance it so a converted step-100K checkpoint
+    # doesn't silently re-run warmup (Adam's own count stays 0: the zero
+    # moments it bias-corrects ARE fresh).
+    state = state.replace(step=jnp.asarray(step, jnp.int32),
+                          opt_state=advance_schedule(state.opt_state, step))
+
+    mgr = CheckpointManager(args.out, keep=1)
+    mgr.save(state, force=True)
+    mgr.wait()
+    mgr.close()
+    n = sum(int(p.size) for p in jax.tree.leaves(params))
+    logging.info("converted %s (%.1fM params, step %d) -> %s",
+                 args.torch_ckpt, n / 1e6, step, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+from diff3d_tpu.convert.torch_ckpt import (convert_state_dict,
+                                           load_torch_checkpoint)
+
+__all__ = ["convert_state_dict", "load_torch_checkpoint"]

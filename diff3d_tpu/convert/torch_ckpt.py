@@ -1,0 +1,187 @@
+"""Convert reference PyTorch checkpoints to this framework's params.
+
+Reference users have pretrained ``.pt`` files (``torch.save({'model':
+state_dict, 'optim': ..., 'step': ...})`` — ``/root/reference/
+train.py:287-298``; distributed weights on Google Drive, README.md:37).
+This module maps that state dict onto the Flax X-UNet's parameter tree so
+they can resume/sample here without retraining.
+
+Key-scheme source (reference ``xunet.py``, naming read from the module
+constructors — see file:line notes inline):
+
+  * ``conditioningprocessor.logsnr_emb_emb.{0,2}`` (Sequential Linear/
+    SiLU/Linear, xunet.py:272-277) -> ``conditioningprocessor/Dense_{0,1}``
+  * ``conditioningprocessor.{pos_emb,first_emb,other_emb}``
+    (xunet.py:280-290, channel-first) -> channels-last params
+  * ``conditioningprocessor.convs.{i}`` (xunet.py:292-299) ->
+    ``level_conv_{i}``
+  * ``conv`` (stem, xunet.py:385) -> ``stem_conv``
+  * ``xunetblocks.{L}.{B}`` (xunet.py:393-415): B < num_res_blocks is an
+    XUNetBlock -> ``down_{L}_{B}``; the trailing ResnetBlock(resample=
+    'down') -> ``down_{L}_downsample``
+  * ``middle`` (xunet.py:419-424) -> ``middle``
+  * ``upsample.{L}.{B}`` (ModuleDict keyed str(L), xunet.py:427-465):
+    B <= num_res_blocks -> ``up_{L}_{B}``; trailing up-ResnetBlock ->
+    ``up_{L}_upsample``
+  * ``lastgn``/``lastconv`` (xunet.py:472-474) -> ``last_gn``/``last_conv``
+
+Layout conversions: Linear ``[out,in]`` -> ``kernel [in,out]``; Conv2d
+``[O,I,kh,kw]`` -> ``[kh,kw,I,O]``; ``nn.MultiheadAttention``'s packed
+``in_proj_weight [3C,C]`` -> separate ``q/k/v_proj`` kernels; GroupNorm
+``weight/bias`` -> ``scale/bias``.  A leading ``module.`` (DataParallel,
+reference sampling.py:52) is stripped.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import numpy as np
+
+from diff3d_tpu.config import ModelConfig
+
+
+def _linear(sd: Mapping[str, np.ndarray], tkey: str) -> Dict[str, np.ndarray]:
+    return {"kernel": np.ascontiguousarray(sd[f"{tkey}.weight"].T),
+            "bias": np.asarray(sd[f"{tkey}.bias"])}
+
+
+def _conv(sd: Mapping[str, np.ndarray], tkey: str) -> Dict[str, np.ndarray]:
+    w = np.asarray(sd[f"{tkey}.weight"])           # [O, I, kh, kw]
+    return {"kernel": np.ascontiguousarray(w.transpose(2, 3, 1, 0)),
+            "bias": np.asarray(sd[f"{tkey}.bias"])}
+
+
+def _groupnorm(sd: Mapping[str, np.ndarray], tkey: str
+               ) -> Dict[str, Dict[str, np.ndarray]]:
+    # reference GroupNorm wraps nn.GroupNorm as `.gn` (xunet.py:66)
+    return {"GroupNorm_0": {"scale": np.asarray(sd[f"{tkey}.gn.weight"]),
+                            "bias": np.asarray(sd[f"{tkey}.gn.bias"])}}
+
+
+def _attn_layer(sd: Mapping[str, np.ndarray], tkey: str
+                ) -> Dict[str, Dict[str, np.ndarray]]:
+    """``nn.MultiheadAttention`` (xunet.py:161) -> q/k/v/out projections."""
+    w = np.asarray(sd[f"{tkey}.attn.in_proj_weight"])   # [3C, C]
+    b = np.asarray(sd[f"{tkey}.attn.in_proj_bias"])     # [3C]
+    C = w.shape[1]
+    out = {}
+    for i, name in enumerate(("q_proj", "k_proj", "v_proj")):
+        out[name] = {"kernel": np.ascontiguousarray(w[i * C:(i + 1) * C].T),
+                     "bias": b[i * C:(i + 1) * C].copy()}
+    out["out_proj"] = _linear(sd, f"{tkey}.attn.out_proj")
+    return out
+
+
+def _resnet_block(sd: Mapping[str, np.ndarray], tkey: str,
+                  has_skip_proj: bool) -> Dict:
+    out = {
+        "FrameGroupNorm_0": _groupnorm(sd, f"{tkey}.groupnorm0"),
+        "FrameGroupNorm_1": _groupnorm(sd, f"{tkey}.groupnorm1"),
+        "conv1": _conv(sd, f"{tkey}.conv1"),
+        "conv2": _conv(sd, f"{tkey}.conv2"),
+        "FiLM_0": {"Dense_0": _linear(sd, f"{tkey}.film.dense")},
+    }
+    if has_skip_proj:
+        # reference names the 1x1 skip projection `dense` (xunet.py:129)
+        out["skip_proj"] = _conv(sd, f"{tkey}.dense")
+    return out
+
+
+def _attn_block(sd: Mapping[str, np.ndarray], tkey: str) -> Dict:
+    return {
+        "FrameGroupNorm_0": _groupnorm(sd, f"{tkey}.groupnorm"),
+        "attn": _attn_layer(sd, f"{tkey}.attn_layer"),
+        # zero-init 1x1 out conv is `linear` (xunet.py:190)
+        "out_conv": _conv(sd, f"{tkey}.linear"),
+    }
+
+
+def _xunet_block(sd: Mapping[str, np.ndarray], tkey: str,
+                 use_attn: bool) -> Dict:
+    has_skip = f"{tkey}.resnetblock.dense.weight" in sd
+    out = {"resnetblock": _resnet_block(sd, f"{tkey}.resnetblock",
+                                        has_skip)}
+    if use_attn:
+        out["attnblock_self"] = _attn_block(sd, f"{tkey}.attnblock_self")
+        out["attnblock_cross"] = _attn_block(sd, f"{tkey}.attnblock_cross")
+    return out
+
+
+def convert_state_dict(sd: Mapping[str, np.ndarray],
+                       cfg: ModelConfig) -> Dict:
+    """Reference torch state dict -> Flax ``params`` tree for ``XUNet(cfg)``.
+
+    ``sd`` values may be torch tensors or numpy arrays; a ``module.``
+    DataParallel prefix is stripped.
+    """
+    sd = {k[len("module."):] if k.startswith("module.") else k:
+          (v.detach().cpu().numpy() if hasattr(v, "detach") else
+           np.asarray(v))
+          for k, v in sd.items()}
+
+    num_res = cfg.num_resolutions
+    params: Dict = {}
+
+    cp = "conditioningprocessor"
+    cp_tree = {
+        "Dense_0": _linear(sd, f"{cp}.logsnr_emb_emb.0"),
+        "Dense_1": _linear(sd, f"{cp}.logsnr_emb_emb.2"),
+    }
+    if cfg.use_pos_emb:
+        # [D, H, W] -> [H, W, D]
+        cp_tree["pos_emb"] = np.ascontiguousarray(
+            np.asarray(sd[f"{cp}.pos_emb"]).transpose(1, 2, 0))
+    if cfg.use_ref_pose_emb:
+        for k in ("first_emb", "other_emb"):
+            # [1, 1, D, 1, 1] -> [1, 1, 1, 1, D]
+            cp_tree[k] = np.ascontiguousarray(
+                np.asarray(sd[f"{cp}.{k}"]).transpose(0, 1, 3, 4, 2))
+    for i in range(num_res):
+        cp_tree[f"level_conv_{i}"] = _conv(sd, f"{cp}.convs.{i}")
+    params[cp] = cp_tree
+
+    params["stem_conv"] = _conv(sd, "conv")
+
+    for lvl in range(num_res):
+        use_attn = lvl in cfg.attn_levels
+        for blk in range(cfg.num_res_blocks):
+            params[f"down_{lvl}_{blk}"] = _xunet_block(
+                sd, f"xunetblocks.{lvl}.{blk}", use_attn)
+        if lvl != num_res - 1:
+            params[f"down_{lvl}_downsample"] = _resnet_block(
+                sd, f"xunetblocks.{lvl}.{cfg.num_res_blocks}",
+                has_skip_proj=False)
+
+    params["middle"] = _xunet_block(sd, "middle",
+                                    num_res in cfg.attn_levels)
+
+    for lvl in reversed(range(num_res)):
+        use_attn = lvl in cfg.attn_levels
+        for blk in range(cfg.num_res_blocks + 1):
+            params[f"up_{lvl}_{blk}"] = _xunet_block(
+                sd, f"upsample.{lvl}.{blk}", use_attn)
+        if lvl != 0:
+            params[f"up_{lvl}_upsample"] = _resnet_block(
+                sd, f"upsample.{lvl}.{cfg.num_res_blocks + 1}",
+                has_skip_proj=False)
+
+    params["last_gn"] = _groupnorm(sd, "lastgn")
+    params["last_conv"] = _conv(sd, "lastconv")
+    return params
+
+
+def load_torch_checkpoint(path: str, cfg: ModelConfig):
+    """Load a reference ``.pt`` checkpoint (``{'model': state_dict, ...}``
+    or a bare state dict) and convert its model weights.
+
+    Returns ``(params, step)``; ``step`` is 0 when the file carries none.
+    """
+    import torch  # cpu build is in the image
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(ckpt, dict) and "model" in ckpt:
+        sd, step = ckpt["model"], int(ckpt.get("step", 0))
+    else:
+        sd, step = ckpt, 0
+    return convert_state_dict(sd, cfg), step

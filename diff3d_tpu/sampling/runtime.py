@@ -61,14 +61,18 @@ class Sampler:
         self.cfg = cfg
         self.w = jnp.asarray(cfg.diffusion.guidance_weights, jnp.float32)
 
-        def denoise(batch, cond_mask):
-            return model.apply({"params": self.params}, batch,
-                               cond_mask=cond_mask)
-
         d = cfg.diffusion
 
-        def run(record_imgs, record_R, record_T, record_len,
+        # params is a jit ARGUMENT, not a closure constant: closing over
+        # it would bake the full weight set into the compiled program
+        # (hundreds of MB at srn64 scale) and force a recompile for every
+        # checkpoint swap.
+        def run(params, record_imgs, record_R, record_T, record_len,
                 target_R, target_T, K, rng):
+            def denoise(batch, cond_mask):
+                return model.apply({"params": params}, batch,
+                                   cond_mask=cond_mask)
+
             return sample_loop(
                 denoise, record_imgs=record_imgs, record_R=record_R,
                 record_T=record_T, record_len=record_len,
@@ -76,7 +80,8 @@ class Sampler:
                 rng=rng, timesteps=d.timesteps, logsnr_min=d.logsnr_min,
                 logsnr_max=d.logsnr_max, clip_x0=d.clip_x0)
 
-        self._run = jax.jit(run)
+        self._jitted = jax.jit(run)
+        self._run = lambda *args: self._jitted(self.params, *args)
 
     def synthesize(self, views: Dict[str, np.ndarray], rng: jax.Array,
                    out_dir: Optional[str] = None,

@@ -61,6 +61,26 @@ def ema_decay_per_step(cfg: TrainConfig) -> float:
     return float(0.5 ** (cfg.global_batch / cfg.ema_halflife_examples))
 
 
+def advance_schedule(opt_state, step: int):
+    """Return ``opt_state`` with every ``ScaleByScheduleState.count`` set to
+    ``step``, leaving Adam's own count (bias correction for the fresh zero
+    moments) at 0.  Needed when seeding a state from a converted checkpoint:
+    the lr schedule's position lives in optax's internal count, not in
+    ``TrainState.step``, so without this a converted step-100K checkpoint
+    would silently re-run the whole lr warmup."""
+    import jax.numpy as jnp
+
+    def fix(s):
+        if isinstance(s, optax.ScaleByScheduleState):
+            return optax.ScaleByScheduleState(
+                count=jnp.asarray(step, jnp.int32))
+        if isinstance(s, tuple) and not hasattr(s, "_fields"):
+            return tuple(fix(x) for x in s)
+        return s
+
+    return fix(opt_state)
+
+
 def create_train_state(params, cfg: TrainConfig) -> TrainState:
     tx = make_optimizer(cfg)
     return TrainState(
