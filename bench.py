@@ -65,12 +65,16 @@ def _run(global_batch: int, n_steps: int, accum: int = 1):
     # Warmup: compile + 2 steps.
     for _ in range(2):
         state, metrics = step_fn(state, batch, rng)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
+    # Sync by VALUE fetch, not block_until_ready: on tunneled/async
+    # backends block_until_ready can return before remote execution
+    # finishes, inflating throughput by orders of magnitude; fetching the
+    # final loss forces the whole dependent step chain to have run.
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step_fn(state, batch, rng)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     return n_steps / (time.perf_counter() - t0)
 
 

@@ -53,6 +53,26 @@ def main(argv=None) -> None:
     step = args.step if args.step is not None else ckpt_step
 
     params = jax.tree.map(jnp.asarray, params)
+
+    # Fail fast on config/checkpoint mismatch (e.g. a 64px .pt converted
+    # with --config srn128): compare against the model's expected tree
+    # BEFORE writing a checkpoint that would only blow up at restore time.
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.train.trainer import init_params as _init_params
+    expected = jax.eval_shape(
+        lambda: _init_params(XUNet(cfg.model), cfg, jax.random.PRNGKey(0)))
+    exp_flat = dict(jax.tree_util.tree_flatten_with_path(expected)[0])
+    got_flat = dict(jax.tree_util.tree_flatten_with_path(params)[0])
+    missing = exp_flat.keys() - got_flat.keys()
+    extra = got_flat.keys() - exp_flat.keys()
+    bad = [jax.tree_util.keystr(k) for k in exp_flat.keys() & got_flat.keys()
+           if exp_flat[k].shape != got_flat[k].shape]
+    if missing or extra or bad:
+        raise SystemExit(
+            f"checkpoint does not match --config {args.config}: "
+            f"missing={sorted(map(jax.tree_util.keystr, missing))[:5]} "
+            f"extra={sorted(map(jax.tree_util.keystr, extra))[:5]} "
+            f"shape-mismatch={sorted(bad)[:5]}")
     state = create_train_state(params, cfg.train)
     # The lr schedule's position is optax's internal count, not
     # TrainState.step — advance it so a converted step-100K checkpoint

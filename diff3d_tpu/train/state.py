@@ -74,11 +74,14 @@ def advance_schedule(opt_state, step: int):
         if isinstance(s, optax.ScaleByScheduleState):
             return optax.ScaleByScheduleState(
                 count=jnp.asarray(step, jnp.int32))
-        if isinstance(s, tuple) and not hasattr(s, "_fields"):
-            return tuple(fix(x) for x in s)
         return s
 
-    return fix(opt_state)
+    # tree.map with is_leaf recurses through EVERY container (tuples,
+    # namedtuple wrappers like MultiSteps/masked states), stopping at the
+    # schedule states themselves.
+    return jax.tree.map(
+        fix, opt_state,
+        is_leaf=lambda s: isinstance(s, optax.ScaleByScheduleState))
 
 
 def create_train_state(params, cfg: TrainConfig) -> TrainState:

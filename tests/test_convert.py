@@ -241,3 +241,33 @@ def test_advance_schedule_skips_warmup():
     np.testing.assert_allclose(float(warmup_schedule(cfg)(1000)), cfg.lr,
                                rtol=1e-5)
     assert float(warmup_schedule(cfg)(0)) < cfg.lr / 10
+
+
+def test_convert_cli_rejects_config_mismatch(tmp_path, cfg_and_params):
+    """A .pt converted under the wrong --config must fail fast at convert
+    time, not at restore time."""
+    torch = pytest.importorskip("torch")
+    cfg, params = cfg_and_params
+    sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+          for k, v in _invert(jax.tree.map(np.asarray, params), cfg).items()}
+    pt = tmp_path / "latest.pt"
+    torch.save({"model": sd, "step": 1}, pt)
+
+    import dataclasses
+
+    from diff3d_tpu import config as config_lib
+    from diff3d_tpu.cli import convert_cli
+
+    # 'test' preset with a DIFFERENT model shape than the .pt was built for
+    wrong = dataclasses.replace(
+        config_lib.test_config(),
+        model=dataclasses.replace(tiny_cfg(), ch=16))
+    orig = config_lib.test_config
+    config_lib.test_config = lambda *a, **k: wrong
+    try:
+        with pytest.raises(SystemExit, match="does not match"):
+            convert_cli.main(["--torch_ckpt", str(pt),
+                              "--out", str(tmp_path / "ckpt"),
+                              "--config", "test"])
+    finally:
+        config_lib.test_config = orig
