@@ -42,6 +42,10 @@ class ModelConfig:
     # TPU-first additions (no reference counterpart):
     dtype: str = "bfloat16"        # compute dtype; params stay float32
     remat: bool = False            # jax.checkpoint each UNet block
+    # What each rematted block keeps: 'nothing' recomputes everything in
+    # the backward (min memory); 'dots' saves matmul/conv outputs and
+    # recomputes only cheap elementwise ops (less recompute, more HBM).
+    remat_policy: str = "nothing"  # 'nothing' | 'dots'
     attn_impl: str = "auto"        # 'auto' | 'pallas' | 'xla'
 
     @property
@@ -55,6 +59,14 @@ class ModelConfig:
                 f"H={self.H}, W={self.W} must be divisible by {down} "
                 f"(len(ch_mult)-1 downsamplings)"
             )
+        if self.remat_policy not in ("nothing", "dots"):
+            raise ValueError(
+                f"remat_policy={self.remat_policy!r} not in "
+                "('nothing', 'dots')")
+        if self.attn_impl not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"attn_impl={self.attn_impl!r} not in "
+                "('auto', 'pallas', 'xla')")
 
 
 @dataclasses.dataclass(frozen=True)

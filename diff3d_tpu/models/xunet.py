@@ -47,9 +47,17 @@ class XUNet(nn.Module):
         dim_out = [cfg.ch * m for m in cfg.ch_mult]
 
         if cfg.remat:
+            import jax
+
+            policy = {
+                "nothing": None,   # save-nothing: recompute the whole block
+                "dots": jax.checkpoint_policies.dots_saveable,
+            }[cfg.remat_policy]
             # argnums count `self` as 0, so `deterministic` is 3
-            block_cls = nn.remat(XUNetBlock, static_argnums=(3,))
-            resnet_cls = nn.remat(ResnetBlock, static_argnums=(3,))
+            block_cls = nn.remat(XUNetBlock, static_argnums=(3,),
+                                 policy=policy)
+            resnet_cls = nn.remat(ResnetBlock, static_argnums=(3,),
+                                  policy=policy)
         else:
             block_cls, resnet_cls = XUNetBlock, ResnetBlock
 

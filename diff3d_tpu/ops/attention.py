@@ -19,21 +19,28 @@ import jax
 import jax.numpy as jnp
 
 
-def _default_backend() -> str:
-    # NOT cached: a process can trace for several backends (e.g. a TPU
-    # entry check followed by a CPU-mesh dry run).
+def _resolve_auto(q: jnp.ndarray) -> str:
+    """Measured policy (one v5e chip, X-UNet shapes — see tools/tune_train):
+    the Pallas flash kernel zero-pads the head dim to the 128-lane MXU
+    tile, so at D=32/64 it wastes 4x/2x of every QK^T and PV matmul and
+    XLA's fused attention wins; only lane-filling heads (D > 64) with
+    sequences long enough that the materialised [L, L] logits' HBM traffic
+    dominates are worth the flash kernel."""
     try:
         platform = jax.default_backend()
     except RuntimeError:  # no backend at trace time; be conservative
         platform = "cpu"
-    return "pallas" if platform == "tpu" else "xla"
+    if platform != "tpu":
+        return "xla"
+    D, L = q.shape[-1], q.shape[1]
+    return "pallas" if (D > 64 and L >= 4096) else "xla"
 
 
 def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
          impl: str = "auto") -> jnp.ndarray:
     """Scaled dot-product attention over ``[B, L, H, D]`` tensors."""
     if impl == "auto":
-        impl = _default_backend()
+        impl = _resolve_auto(q)
     if impl == "pallas":
         from diff3d_tpu.ops.pallas_attention import flash_attention, supports
         if supports(q, k, v):

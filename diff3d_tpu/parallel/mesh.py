@@ -73,11 +73,15 @@ def make_mesh(cfg: MeshConfig = MeshConfig(),
     """Build a ``(data, model)`` mesh over all (or given) devices.
 
     ``data_parallel == -1`` takes every device not claimed by
-    ``model_parallel``.  Device order follows ``jax.devices()``, which
-    groups hosts contiguously — so the data axis splits across hosts (DCN)
-    only after filling each host's chips (ICI), the layout the scaling
-    playbook prescribes for pure DP.
+    ``model_parallel``.  Placement is ICI-topology-aware: on a full
+    device set ``mesh_utils.create_device_mesh`` orders the grid so the
+    (inner) model axis rides the fastest ICI links, and on multi-slice
+    TPU (slices joined by DCN) ``create_hybrid_device_mesh`` keeps the
+    model axis inside a slice and splits only the data axis across the
+    DCN boundary — the scaling-playbook layout.  Explicit device subsets
+    (tests, dry runs) fall back to a plain reshape of the given order.
     """
+    explicit = devices is not None
     devices = list(devices if devices is not None else jax.devices())
     mp = max(1, cfg.model_parallel)
     dp = cfg.data_parallel
@@ -86,9 +90,35 @@ def make_mesh(cfg: MeshConfig = MeshConfig(),
     if dp * mp > len(devices):
         raise ValueError(
             f"mesh {dp}x{mp} needs {dp * mp} devices, have {len(devices)}")
-    grid = np.asarray(devices[: dp * mp]).reshape(dp, mp)
+    grid = _device_grid(devices[: dp * mp], dp, mp,
+                        topology_aware=not explicit)
     mesh = Mesh(grid, (cfg.data_axis, cfg.model_axis))
     return MeshEnv(mesh=mesh, cfg=cfg)
+
+
+def _device_grid(devices: list, dp: int, mp: int,
+                 topology_aware: bool) -> np.ndarray:
+    """[dp, mp] device grid, ICI/DCN-aware when possible."""
+    fallback = np.asarray(devices).reshape(dp, mp)
+    if not topology_aware or len(devices) <= 1:
+        return fallback
+    try:
+        from jax.experimental import mesh_utils
+
+        slices = {getattr(d, "slice_index", 0) for d in devices}
+        if len(slices) > 1:
+            # Multi-slice: model axis must stay inside a slice (ICI); the
+            # data axis absorbs the across-slice (DCN) factor.
+            n_slices = len(slices)
+            if dp % n_slices:
+                return fallback
+            return mesh_utils.create_hybrid_device_mesh(
+                (dp // n_slices, mp), (n_slices, 1), devices=devices)
+        return mesh_utils.create_device_mesh((dp, mp), devices=devices)
+    except Exception:
+        # Any topology helper failure (odd shapes, virtual devices) must
+        # never block mesh construction.
+        return fallback
 
 
 def batch_sharding(mesh: Mesh, data_axis: str = "data") -> NamedSharding:

@@ -103,9 +103,15 @@ class Sampler:
 
         # Fixed-size record buffer; entry 0 is the GT first view repeated
         # across the guidance batch (reference sampling.py:160-162).
-        record_imgs = np.zeros((n_views, B, H, W, 3), np.float32)
-        record_R = np.zeros((n_views, 3, 3), np.float32)
-        record_T = np.zeros((n_views, 3), np.float32)
+        # Capacity rounds up to a power of two: the compiled scan's shape
+        # depends on it, so objects with different view counts share a
+        # logarithmic number of compilations instead of one each.  The
+        # stochastic-conditioning draw only sees the first `record_len`
+        # entries, so padding never leaks into sampling.
+        capacity = 1 << (n_views - 1).bit_length()
+        record_imgs = np.zeros((capacity, B, H, W, 3), np.float32)
+        record_R = np.zeros((capacity, 3, 3), np.float32)
+        record_T = np.zeros((capacity, 3), np.float32)
         record_imgs[0] = imgs[0][None]
         record_R[0], record_T[0] = R[0], T[0]
 

@@ -185,9 +185,10 @@ def test_xunet_dropout_rng_path():
     assert out.shape == (B, cfg.H, cfg.W, 3)
 
 
-def test_xunet_remat_matches():
+@pytest.mark.parametrize("policy", ["nothing", "dots"])
+def test_xunet_remat_matches(policy):
     cfg = tiny_cfg()
-    cfg_r = tiny_cfg(remat=True)
+    cfg_r = tiny_cfg(remat=True, remat_policy=policy)
     B = 2
     batch = make_batch(B, cfg.H, cfg.W)
     v = XUNet(cfg).init(jax.random.PRNGKey(0), batch,
@@ -195,6 +196,15 @@ def test_xunet_remat_matches():
     a = XUNet(cfg).apply(v, batch, cond_mask=jnp.ones(B, bool))
     b = XUNet(cfg_r).apply(v, batch, cond_mask=jnp.ones(B, bool))
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # The policy must also hold up under differentiation (the whole point
+    # of remat is the backward pass).
+    def loss(params):
+        return jnp.mean(XUNet(cfg_r).apply(
+            {"params": params}, batch, cond_mask=jnp.ones(B, bool)) ** 2)
+
+    g = jax.grad(loss)(jax.tree.map(lambda x: x + 0.01, v["params"]))
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
 
 
 def test_xunet_rejects_bad_size():

@@ -94,3 +94,21 @@ def test_multi_head_attention_wrapper():
     x = jnp.asarray(rng.randn(2, 64, 128), jnp.float32)
     out = multi_head_attention(x, x, x, num_heads=4, impl="xla")
     assert out.shape == (2, 64, 128)
+
+
+def test_resolve_auto_policy(monkeypatch):
+    """'auto' routes per measured policy: XLA off-TPU always; on TPU the
+    flash kernel only for lane-filling heads (D > 64) at L >= 4096."""
+    from diff3d_tpu.ops import attention as att
+
+    def q(L, D):
+        return jnp.zeros((1, L, 4, D))
+
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "cpu")
+    assert att._resolve_auto(q(16384, 128)) == "xla"  # off-TPU: always xla
+
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    assert att._resolve_auto(q(4096, 32)) == "xla"    # 4x lane padding
+    assert att._resolve_auto(q(4096, 64)) == "xla"    # 2x lane padding
+    assert att._resolve_auto(q(4096, 128)) == "pallas"
+    assert att._resolve_auto(q(1024, 128)) == "xla"   # short seq
