@@ -46,7 +46,10 @@ class ModelConfig:
     # the backward (min memory); 'dots' saves matmul/conv outputs and
     # recomputes only cheap elementwise ops (less recompute, more HBM).
     remat_policy: str = "nothing"  # 'nothing' | 'dots'
-    attn_impl: str = "auto"        # 'auto' | 'pallas' | 'xla'
+    # 'auto' | 'pallas' | 'xla', or a sequence-parallel core
+    # 'ring:<axis>' / 'ulysses:<axis>' for token-sharded attention inside
+    # shard_map (long-context scaling; see ops/attention.py).
+    attn_impl: str = "auto"
 
     @property
     def num_resolutions(self) -> int:
@@ -63,10 +66,13 @@ class ModelConfig:
             raise ValueError(
                 f"remat_policy={self.remat_policy!r} not in "
                 "('nothing', 'dots')")
-        if self.attn_impl not in ("auto", "pallas", "xla"):
+        ok = (self.attn_impl in ("auto", "pallas", "xla")
+              or (self.attn_impl.partition(":")[0] in ("ring", "ulysses")
+                  and self.attn_impl.partition(":")[2]))
+        if not ok:
             raise ValueError(
-                f"attn_impl={self.attn_impl!r} not in "
-                "('auto', 'pallas', 'xla')")
+                f"attn_impl={self.attn_impl!r}: expected 'auto', 'pallas', "
+                "'xla', 'ring:<axis>' or 'ulysses:<axis>'")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,6 +144,14 @@ class MeshConfig:
     # out-proj row-parallel, conv output channels); 'fsdp+tp' composes
     # both (TP rule first, then the largest free axis over data).
     param_sharding: str = "replicated"
+    # GSPMD context parallelism: shard the activations' spatial (image-row
+    # = token) axis over the model axis via sharding constraints between
+    # UNet blocks; XLA inserts conv halo exchanges, global GroupNorm
+    # reductions, and attention KV gathers.  Activation memory per device
+    # drops by the axis size — for resolutions past what one chip's HBM
+    # holds.  (The shard_map alternative for the attention op alone is
+    # ModelConfig.attn_impl='ring:<axis>'.)
+    context_parallel: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

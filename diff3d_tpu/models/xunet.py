@@ -35,9 +35,16 @@ class XUNet(nn.Module):
 
     @nn.compact
     def __call__(self, batch: dict, *, cond_mask: jnp.ndarray,
-                 deterministic: bool = True) -> jnp.ndarray:
+                 deterministic: bool = True,
+                 constrain=None) -> jnp.ndarray:
+        """``constrain`` (optional ``h -> h``): sharding-constraint hook
+        applied to every block's ``[B, F, h, w, C]`` output — GSPMD context
+        parallelism when it pins the spatial axis to a mesh axis
+        (``MeshEnv.activation_constraint``); identity otherwise."""
         cfg = self.cfg
         cfg.validate()
+        if constrain is None:
+            constrain = lambda h: h  # noqa: E731
         dtype = jnp.dtype(cfg.dtype)
         B, H, W, C = batch["x"].shape
         assert (H, W) == (cfg.H, cfg.W), ((H, W), (cfg.H, cfg.W))
@@ -77,7 +84,7 @@ class XUNet(nn.Module):
         F = h.shape[1]
         h = nn.Conv(cfg.ch, (3, 3), dtype=dtype,
                     name="stem_conv")(h.reshape(B * F, H, W, C))
-        h = h.reshape(B, F, H, W, cfg.ch)
+        h = constrain(h.reshape(B, F, H, W, cfg.ch))
 
         # Down path (reference xunet.py:498-512).
         hs = [h]
@@ -85,25 +92,25 @@ class XUNet(nn.Module):
             emb = level_emb(i_level)
             use_attn = i_level in cfg.attn_levels
             for i_block in range(cfg.num_res_blocks):
-                h = block_cls(
+                h = constrain(block_cls(
                     features=dim_out[i_level], use_attn=use_attn,
                     num_heads=cfg.attn_heads, dropout=cfg.dropout,
                     attn_impl=cfg.attn_impl, dtype=dtype,
-                    name=f"down_{i_level}_{i_block}")(h, emb, deterministic)
+                    name=f"down_{i_level}_{i_block}")(h, emb, deterministic))
                 hs.append(h)
             if i_level != num_res - 1:
-                h = resnet_cls(
+                h = constrain(resnet_cls(
                     features=dim_out[i_level], dropout=cfg.dropout,
                     resample="down", dtype=dtype,
-                    name=f"down_{i_level}_downsample")(h, emb, deterministic)
+                    name=f"down_{i_level}_downsample")(h, emb, deterministic))
                 hs.append(h)
 
         # Middle (reference xunet.py:419-424,515-517).
-        h = block_cls(
+        h = constrain(block_cls(
             features=dim_out[-1], use_attn=num_res in cfg.attn_levels,
             num_heads=cfg.attn_heads, dropout=cfg.dropout,
             attn_impl=cfg.attn_impl, dtype=dtype,
-            name="middle")(h, level_emb(num_res - 1), deterministic)
+            name="middle")(h, level_emb(num_res - 1), deterministic))
 
         # Up path (reference xunet.py:521-531): each block consumes
         # concat([h, skip]) on the channel axis.
@@ -112,16 +119,16 @@ class XUNet(nn.Module):
             use_attn = i_level in cfg.attn_levels
             for i_block in range(cfg.num_res_blocks + 1):
                 h = jnp.concatenate([h, hs.pop()], axis=-1)
-                h = block_cls(
+                h = constrain(block_cls(
                     features=dim_out[i_level], use_attn=use_attn,
                     num_heads=cfg.attn_heads, dropout=cfg.dropout,
                     attn_impl=cfg.attn_impl, dtype=dtype,
-                    name=f"up_{i_level}_{i_block}")(h, emb, deterministic)
+                    name=f"up_{i_level}_{i_block}")(h, emb, deterministic))
             if i_level != 0:
-                h = resnet_cls(
+                h = constrain(resnet_cls(
                     features=dim_out[i_level], dropout=cfg.dropout,
                     resample="up", dtype=dtype,
-                    name=f"up_{i_level}_upsample")(h, emb, deterministic)
+                    name=f"up_{i_level}_upsample")(h, emb, deterministic))
         assert not hs
 
         # Head: GN -> SiLU -> zero-init conv -> target frame's eps-hat

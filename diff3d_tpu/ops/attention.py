@@ -38,9 +38,23 @@ def _resolve_auto(q: jnp.ndarray) -> str:
 
 def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
          impl: str = "auto") -> jnp.ndarray:
-    """Scaled dot-product attention over ``[B, L, H, D]`` tensors."""
+    """Scaled dot-product attention over ``[B, L, H, D]`` tensors.
+
+    ``impl`` may also name a sequence-parallel core — ``'ring:<axis>'`` or
+    ``'ulysses:<axis>'`` — in which case q/k/v are local token shards of a
+    global sequence sharded over mesh axis ``<axis>`` and the call must be
+    inside ``shard_map`` with that axis in scope.  This is how the X-UNet's
+    attention layers scale past one device's tokens: set
+    ``ModelConfig.attn_impl='ring:model'`` and run the step in a
+    ``shard_map`` whose specs shard the spatial axis.
+    """
     if impl == "auto":
         impl = _resolve_auto(q)
+    if ":" in impl:
+        from diff3d_tpu.parallel import ring_sdpa, ulysses_sdpa
+        kind, _, axis = impl.partition(":")
+        fn = {"ring": ring_sdpa, "ulysses": ulysses_sdpa}[kind]
+        return fn(q, k, v, axis_name=axis)
     if impl == "pallas":
         from diff3d_tpu.ops.pallas_attention import flash_attention, supports
         if supports(q, k, v):

@@ -50,6 +50,37 @@ class MeshEnv:
     def replicated(self) -> NamedSharding:
         return replicated_sharding(self.mesh)
 
+    def state_shardings(self, state):
+        """Sharding pytree for a :class:`TrainState`-shaped object: step
+        replicated, params / opt-state / EMA per the param policy.  The one
+        placement rule every trainer, bench, and dry run shares."""
+        return type(state)(
+            step=self.replicated(),
+            params=self.params(state.params),
+            opt_state=self.params(state.opt_state),
+            ema_params=self.params(state.ema_params),
+        )
+
+    def activation_constraint(self):
+        """``h -> h`` hook sharding ``[B, F, H, W, C]`` activations: batch
+        over the data axis, image rows (the token axis once flattened to
+        ``H*W`` sequences — H is the outer dim of the merge, so GSPMD
+        propagates the sharding through the reshape) over the model axis.
+        Threaded through :meth:`XUNet.__call__ <diff3d_tpu.models.xunet.
+        XUNet.__call__>`'s ``constrain`` kwarg when
+        ``MeshConfig.context_parallel`` is on."""
+        import jax
+
+        sh = NamedSharding(
+            self.mesh, P(self.cfg.data_axis, None, self.cfg.model_axis))
+
+        def constrain(h):
+            if h.ndim != 5:
+                return h
+            return jax.lax.with_sharding_constraint(h, sh)
+
+        return constrain
+
     def params(self, pytree) -> object:
         """Sharding pytree for params/opt-state per the config policy."""
         mode = self.cfg.param_sharding

@@ -44,6 +44,11 @@ def make_train_step(model, cfg: Config, env: MeshEnv | None = None,
     dcfg = cfg.diffusion
 
     accum = max(1, cfg.train.accum_steps)
+    # GSPMD context parallelism: constrain activations' spatial axis onto
+    # the model axis so XLA compiles conv halo exchanges / GN reductions /
+    # attention KV gathers (MeshConfig.context_parallel).
+    constrain = (env.activation_constraint()
+                 if env is not None and cfg.mesh.context_parallel else None)
 
     def loss_and_grad(params, batch, rng):
         rng, k_drop = jax.random.split(rng)
@@ -52,7 +57,8 @@ def make_train_step(model, cfg: Config, env: MeshEnv | None = None,
             def denoise(model_batch, cond_mask):
                 return model.apply({"params": params}, model_batch,
                                    cond_mask=cond_mask, deterministic=False,
-                                   rngs={"dropout": k_drop})
+                                   rngs={"dropout": k_drop},
+                                   constrain=constrain)
             return p_losses(
                 denoise, batch["imgs"], batch["R"], batch["T"], batch["K"],
                 rng, cond_prob=dcfg.cond_prob, loss_type=dcfg.loss_type,
@@ -107,20 +113,12 @@ def make_train_step(model, cfg: Config, env: MeshEnv | None = None,
     batch_sh = env.batch()
     rep = env.replicated()
 
-    def shard_for_state(state: TrainState):
-        return TrainState(
-            step=rep,
-            params=env.params(state.params),
-            opt_state=env.params(state.opt_state),
-            ema_params=env.params(state.ema_params),
-        )
-
     jitted = None  # built on first call (shardings come from the pytrees)
 
     def sharded_step(state, batch, rng):
         nonlocal jitted
         if jitted is None:
-            st_sh = shard_for_state(state)
+            st_sh = env.state_shardings(state)
             batch_shardings = jax.tree.map(lambda _: batch_sh, batch)
             jitted = jax.jit(
                 step_fn,

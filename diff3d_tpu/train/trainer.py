@@ -91,10 +91,7 @@ class Trainer:
         self._metrics_path = os.path.join(workdir, "metrics.jsonl")
 
     def _state_shardings(self, state: TrainState) -> TrainState:
-        return TrainState(step=self.env.replicated(),
-                          params=self.env.params(state.params),
-                          opt_state=self.env.params(state.opt_state),
-                          ema_params=self.env.params(state.ema_params))
+        return self.env.state_shardings(state)
 
     def _abstract_state(self) -> TrainState:
         abstract = jax.eval_shape(

@@ -15,6 +15,22 @@ def test_train_cli_flag_parity():
     assert args.transfer and args.train_data == "/x"
 
 
+def test_train_cli_parallelism_and_model_flags():
+    p = train_cli.build_parser()
+    args = p.parse_args(["--context_parallel", "--model_parallel", "2",
+                         "--remat", "--remat_policy", "dots",
+                         "--attn_impl", "xla"])
+    assert args.context_parallel and args.model_parallel == 2
+    assert args.remat is True and args.remat_policy == "dots"
+    assert args.attn_impl == "xla"
+    assert p.parse_args(["--no-remat"]).remat is False
+    assert p.parse_args([]).remat is None
+    # ring/ulysses need a caller-bound shard_map axis; the trainer can't
+    # provide one, so the CLI rejects them (use --context_parallel there).
+    with pytest.raises(SystemExit):
+        p.parse_args(["--attn_impl", "ring:model"])
+
+
 def test_sample_cli_flag_parity():
     p = sample_cli.build_parser()
     args = p.parse_args(["--model", "/ckpt", "--target", "/obj"])

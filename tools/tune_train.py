@@ -28,8 +28,7 @@ def run_variant(global_batch: int, accum: int, remat: bool, policy: str,
     from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.parallel import make_mesh
-    from diff3d_tpu.train import (TrainState, create_train_state,
-                                  make_train_step)
+    from diff3d_tpu.train import create_train_state, make_train_step
     from diff3d_tpu.train.trainer import init_params
 
     cfg = srn64_config()
@@ -44,11 +43,7 @@ def run_variant(global_batch: int, accum: int, remat: bool, policy: str,
     model = XUNet(cfg.model)
     rng = jax.random.PRNGKey(0)
     state = create_train_state(init_params(model, cfg, rng), cfg.train)
-    state = jax.device_put(
-        state, TrainState(step=env.replicated(),
-                          params=env.params(state.params),
-                          opt_state=env.params(state.opt_state),
-                          ema_params=env.params(state.ema_params)))
+    state = jax.device_put(state, env.state_shardings(state))
 
     ds = SyntheticDataset(num_objects=8, num_views=16,
                           imgsize=cfg.model.H, seed=0)
