@@ -92,25 +92,34 @@ def main() -> None:
 
     steps_per_sec, global_batch, accum, err = None, None, 1, None
     for global_batch, accum in configs:
-        try:
-            steps_per_sec = _run(global_batch, n_steps, accum)
+        # The tunneled compile helper dies transiently on big programs;
+        # retry ONLY that error class once before falling back.  OOM
+        # (RESOURCE_EXHAUSTED) is deterministic — straight to the next
+        # config.  Other INTERNAL errors are real failures and propagate.
+        for attempt in (0, 1):
+            try:
+                steps_per_sec = _run(global_batch, n_steps, accum)
+                break
+            except Exception as e:
+                msg = str(e)
+                compile_helper_died = ("remote_compile" in msg
+                                       or "tpu_compile" in msg)
+                oom = ("RESOURCE_EXHAUSTED" in msg
+                       or "memory" in msg.lower())
+                if not (oom or compile_helper_died):
+                    raise
+                # Keep only the message: holding the exception would pin
+                # the failed attempt's traceback frames (train state,
+                # batch) and their HBM buffers across the retry.
+                err = msg.splitlines()[0]
+                retrying = compile_helper_died and attempt == 0
+                print(f"bench: b{global_batch}x{accum} failed ({err}); "
+                      + ("retrying" if retrying else "trying next config"),
+                      file=sys.stderr)
+                if not retrying:
+                    break
+        if steps_per_sec is not None:
             break
-        except Exception as e:
-            # OOM (RESOURCE_EXHAUSTED) or the remote-compile helper dying
-            # on a too-big program both mean "try the next config"; other
-            # INTERNAL errors are real failures and propagate.
-            msg = str(e)
-            compile_helper_died = ("remote_compile" in msg
-                                   or "tpu_compile" in msg)
-            if ("RESOURCE_EXHAUSTED" not in msg and "memory" not in
-                    msg.lower() and not compile_helper_died):
-                raise
-            # Keep only the message: holding the exception would pin the
-            # failed attempt's traceback frames (train state, batch) and
-            # their HBM buffers across the retry.
-            err = msg.splitlines()[0]
-            print(f"bench: b{global_batch}x{accum} failed ({err}); "
-                  "trying next config", file=sys.stderr)
     if steps_per_sec is None:
         raise SystemExit(f"bench failed at every batch size: {err}")
 

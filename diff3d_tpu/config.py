@@ -108,6 +108,11 @@ class TrainConfig:
     # batch-64 activations (no reference counterpart; their answer to OOM
     # was "use a smaller image size", README.md:39).
     accum_steps: int = 1
+    # Validation-loss cadence (0 disables).  The reference's own TODO #1
+    # ("Assessing the behavior of the loss along training", README.md:32)
+    # — it never had a val path; here attach Trainer.val_loader and the
+    # EMA params are scored on held-out batches every `eval_every` steps.
+    eval_every: int = 0
     seed: int = 0
     checkpoint_dir: str = "checkpoints"
     keep_checkpoints: int = 3
@@ -164,6 +169,11 @@ class Config:
 
     def validate(self) -> None:
         self.model.validate()
+        if self.mesh.context_parallel and self.mesh.model_parallel <= 1:
+            raise ValueError(
+                "context_parallel shards the spatial axis over the model "
+                f"axis, but model_parallel={self.mesh.model_parallel} makes "
+                "that a no-op — set model_parallel > 1")
         if self.train.global_batch % max(1, self.train.accum_steps):
             raise ValueError(
                 f"global_batch ({self.train.global_batch}) must be "

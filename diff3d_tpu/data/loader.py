@@ -89,12 +89,16 @@ def prefetch_to_device(it: Iterator, sharding=None, depth: int = 2,
 
     def producer():
         try:
+            from diff3d_tpu.parallel.multihost import shard_host_local
+
             for batch in it:
                 if stop.is_set():
                     return
                 if to_device:
-                    batch = jax.tree.map(
-                        lambda x: jax.device_put(x, sharding), batch)
+                    # Multi-host: each host's local slice becomes its
+                    # shards of ONE global array (make_array_from_
+                    # process_local_data); single-host: plain device_put.
+                    batch = shard_host_local(batch, sharding)
                 q.put(batch)
         except BaseException as e:  # surface on the consumer side
             error.append(e)

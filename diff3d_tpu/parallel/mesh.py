@@ -69,8 +69,6 @@ class MeshEnv:
         Threaded through :meth:`XUNet.__call__ <diff3d_tpu.models.xunet.
         XUNet.__call__>`'s ``constrain`` kwarg when
         ``MeshConfig.context_parallel`` is on."""
-        import jax
-
         sh = NamedSharding(
             self.mesh, P(self.cfg.data_axis, None, self.cfg.model_axis))
 

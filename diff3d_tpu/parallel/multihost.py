@@ -52,3 +52,23 @@ def is_primary() -> bool:
     """True on the process that owns checkpoint/metric writes (the
     reference gates these on rank 0, ``train.py:287-298``)."""
     return jax.process_index() == 0
+
+
+def shard_host_local(tree, sharding):
+    """Assemble per-host local batch arrays into global sharded arrays.
+
+    Each host's loader yields its own ``global_batch / num_hosts`` slice
+    (``InfiniteLoader(host_id=..., num_hosts=...)``); multi-process runs
+    must go through ``jax.make_array_from_process_local_data`` so the
+    global array's shards come from each host's slice — a plain
+    ``device_put`` would treat every host's (different) local array as
+    the same global value, which is undefined across processes.
+    Single-process keeps the cheap ``device_put``.
+    """
+    import numpy as np
+
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)), tree)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

@@ -16,6 +16,8 @@ from __future__ import annotations
 import json
 import logging
 import os
+import signal
+import threading
 import time
 from typing import Iterator, Optional
 
@@ -24,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from diff3d_tpu.config import Config
+from diff3d_tpu.diffusion import p_losses
 from diff3d_tpu.models import XUNet
 from diff3d_tpu.parallel import MeshEnv, make_mesh
 from diff3d_tpu.parallel.multihost import is_primary
@@ -89,6 +92,56 @@ class Trainer:
 
         self.step_fn = make_train_step(self.model, cfg, self.env)
         self._metrics_path = os.path.join(workdir, "metrics.jsonl")
+        self._preempted = threading.Event()
+        self._eval_fn = None
+        self.val_loader: Optional[Iterator] = None
+
+    def install_preemption_handler(self,
+                                   signals=(signal.SIGTERM,)) -> None:
+        """Catch preemption signals (SIGTERM is what TPU maintenance /
+        spot reclamation sends) and finish gracefully: the training loop
+        checkpoints the current state and returns instead of dying
+        mid-step.  Restart with ``transfer=True`` to resume.  (The
+        reference's only recovery story is rerunning with ``--transfer``
+        from the last 50-step save — ``train.py:238-251``.)"""
+
+        def handler(signum, frame):
+            log.warning("signal %d: checkpointing and stopping", signum)
+            self._preempted.set()
+
+        for s in signals:
+            signal.signal(s, handler)
+
+    def _eval_step(self, state: TrainState, batch, rng):
+        """Validation loss (EMA params, no dropout, no CFG randomness
+        beyond the rng given) — compiled on first use with the same
+        global shardings as the train step, so multi-host runs evaluate
+        ONE globally-assembled val batch (each host contributes its
+        shard) rather than racing host-local batches through a shared
+        computation."""
+        from diff3d_tpu.parallel.multihost import shard_host_local
+        batch = shard_host_local(batch, self.env.batch())
+        if self._eval_fn is None:
+            dcfg = self.cfg.diffusion
+
+            def eval_fn(params, batch, rng):
+                def denoise(model_batch, cond_mask):
+                    return self.model.apply({"params": params}, model_batch,
+                                            cond_mask=cond_mask)
+                return p_losses(
+                    denoise, batch["imgs"], batch["R"], batch["T"],
+                    batch["K"], rng, cond_prob=dcfg.cond_prob,
+                    loss_type=dcfg.loss_type, logsnr_min=dcfg.logsnr_min,
+                    logsnr_max=dcfg.logsnr_max)
+
+            self._eval_fn = jax.jit(
+                eval_fn,
+                in_shardings=(self.env.params(state.ema_params),
+                              jax.tree.map(lambda _: self.env.batch(),
+                                           batch),
+                              self.env.replicated()),
+                out_shardings=self.env.replicated())
+        return self._eval_fn(state.ema_params, batch, rng)
 
     def _state_shardings(self, state: TrainState) -> TrainState:
         return self.env.state_shardings(state)
@@ -191,6 +244,23 @@ class Trainer:
                             f"at step {step}; last finite checkpoint "
                             "preserved")
                     self.ckpt.save(self.state)
+
+                if (self.val_loader is not None and cfg.eval_every
+                        and step % cfg.eval_every == 0):
+                    vb = next(self.val_loader)
+                    vloss = float(self._eval_step(
+                        self.state,
+                        {"imgs": vb["imgs"], "R": vb["R"], "T": vb["T"],
+                         "K": vb["K"]},
+                        jax.random.fold_in(self.rng, step)))
+                    self._log({"step": step, "val_loss": vloss})
+                    log.info("step %d val_loss %.4f", step, vloss)
+
+                if self._preempted.is_set():
+                    # Graceful preemption: persist the exact step and stop.
+                    self.ckpt.save(self.state, force=True)
+                    log.warning("preempted at step %d; state saved", step)
+                    break
         except FloatingPointError:
             raise
         except BaseException:

@@ -315,3 +315,71 @@ def test_context_parallel_step_matches_replicated():
                                rtol=1e-5)
     for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_val_loss_logged(tmp_path):
+    """eval_every scores EMA params on val batches into metrics.jsonl —
+    the reference's own unfinished TODO #1 (README.md:32)."""
+    import json
+
+    cfg = tiny_cfg(max_steps=2, eval_every=2, ckpt_every=2, log_every=1)
+    env = make_mesh()
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=cfg.model.H)
+    tr = Trainer(cfg, InfiniteLoader(ds, cfg.train.global_batch,
+                                     num_workers=0),
+                 env, workdir=str(tmp_path))
+    tr.val_loader = InfiniteLoader(
+        SyntheticDataset(num_objects=2, num_views=4, imgsize=cfg.model.H,
+                         seed=1),
+        cfg.train.global_batch, num_workers=0)
+    tr.train()
+    recs = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    vals = [r for r in recs if "val_loss" in r]
+    assert vals and np.isfinite(vals[0]["val_loss"])
+
+
+def test_preemption_checkpoints_and_stops(tmp_path):
+    """A preemption signal makes the loop checkpoint the current step and
+    return (graceful TPU spot/maintenance handling; the reference dies
+    mid-step and loses up to 50 steps)."""
+    cfg = tiny_cfg(max_steps=50, ckpt_every=100, log_every=100)
+    env = make_mesh()
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=cfg.model.H)
+
+    class PreemptAfter:
+        """Loader that raises the flag after a few batches."""
+
+        def __init__(self, inner, trainer_box, after):
+            self.inner, self.box, self.n, self.after = inner, trainer_box, 0, after
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.n += 1
+            if self.n == self.after:
+                self.box[0]._preempted.set()   # what the signal handler does
+            return next(self.inner)
+
+    box = [None]
+    loader = PreemptAfter(
+        InfiniteLoader(ds, cfg.train.global_batch, num_workers=0), box, 3)
+    tr = Trainer(cfg, loader, env, workdir=str(tmp_path))
+    box[0] = tr
+    state = tr.train()
+    assert int(state.step) == 3          # stopped right after the flag
+    tr.ckpt.wait()
+    assert tr.ckpt.latest_step() == 3    # exact-step checkpoint exists
+
+    # resume picks up at the preempted step
+    tr2 = Trainer(cfg, None, env, workdir=str(tmp_path), transfer=True)
+    assert int(tr2.state.step) == 3
+
+
+def test_context_parallel_requires_model_axis():
+    import dataclasses
+
+    cfg = tiny_cfg()
+    cfg = dataclasses.replace(cfg, mesh=MeshConfig(context_parallel=True))
+    with pytest.raises(ValueError, match="model_parallel"):
+        cfg.validate()
