@@ -112,6 +112,20 @@ class Trainer:
         for s in signals:
             signal.signal(s, handler)
 
+    def _stop_requested(self, step: int) -> bool:
+        """Multi-host-safe preemption check.  A process-local flag alone
+        would deadlock a pod: hosts observing SIGTERM at different step
+        boundaries would split between a collective checkpoint save and a
+        collective train step.  On multi-process runs the decision goes
+        through the coordination service's preemption-sync protocol (any
+        host's notice propagates to all, and all agree on the same stop
+        step); the local flag feeds single-process runs and tests."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.reached_preemption_sync_point(step)
+        return self._preempted.is_set()
+
     def _eval_step(self, state: TrainState, batch, rng):
         """Validation loss (EMA params, no dropout, no CFG randomness
         beyond the rng given) — compiled on first use with the same
@@ -229,6 +243,7 @@ class Trainer:
                             f"non-finite loss {loss} at step {step}; "
                             "last finite checkpoint preserved")
 
+                saved_this_step = False
                 if step % cfg.ckpt_every == 0 or step >= max_steps:
                     # Never persist a poisoned state: ckpt cadence need not
                     # align with log cadence, so check this step's health
@@ -243,7 +258,7 @@ class Trainer:
                             f"non-finite loss {loss} / grad_norm {gnorm} "
                             f"at step {step}; last finite checkpoint "
                             "preserved")
-                    self.ckpt.save(self.state)
+                    saved_this_step = self.ckpt.save(self.state)
 
                 if (self.val_loader is not None and cfg.eval_every
                         and step % cfg.eval_every == 0):
@@ -256,9 +271,14 @@ class Trainer:
                     self._log({"step": step, "val_loss": vloss})
                     log.info("step %d val_loss %.4f", step, vloss)
 
-                if self._preempted.is_set():
+                if self._stop_requested(step):
                     # Graceful preemption: persist the exact step and stop.
-                    self.ckpt.save(self.state, force=True)
+                    # Skip the save if the ckpt_every branch above already
+                    # wrote this step — force=True would delete and rewrite
+                    # the finished checkpoint, reopening the loss window a
+                    # mid-rewrite SIGKILL was supposed to be protected from.
+                    if not saved_this_step:
+                        self.ckpt.save(self.state, force=True)
                     log.warning("preempted at step %d; state saved", step)
                     break
         except FloatingPointError:

@@ -1,0 +1,76 @@
+"""Worker for tests/test_multiprocess.py — one JAX process of a
+2-process CPU 'pod'.
+
+Argv: process_id num_processes coordinator_address out_dir
+
+Each process owns 2 virtual CPU devices (XLA_FLAGS set by the parent),
+so the job forms a 4-device global mesh across 2 processes — the
+multi-host topology the framework targets on TPU pods, minus the TPUs.
+Exercises: jax.distributed.initialize, cross-process mesh construction,
+per-host data assembly (shard_host_local's multi-process branch), the
+sharded train step's cross-process gradient all-reduce, and primary-gated
+side effects.  Writes the per-step losses to out_dir/loss_<pid>.json.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid, nprocs, coord, out_dir = (int(sys.argv[1]), int(sys.argv[2]),
+                                   sys.argv[3], sys.argv[4])
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs
+    assert jax.device_count() == 2 * nprocs, jax.device_count()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import dataclasses
+
+    from diff3d_tpu.config import test_config
+    from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.parallel import make_mesh
+    from diff3d_tpu.parallel.multihost import is_primary, shard_host_local
+    from diff3d_tpu.train import create_train_state, make_train_step
+    from diff3d_tpu.train.trainer import init_params
+
+    cfg = test_config(imgsize=8, ch=8)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, global_batch=8))
+
+    env = make_mesh(cfg.mesh)   # 4-device data mesh spanning 2 processes
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(init_params(model, cfg, rng), cfg.train)
+    state = jax.device_put(state, env.state_shardings(state))
+
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=cfg.model.H)
+    per_host = cfg.train.global_batch // nprocs
+    loader = InfiniteLoader(ds, per_host, seed=0, host_id=pid,
+                            num_hosts=nprocs, num_workers=0)
+
+    step_fn = make_train_step(model, cfg, env)
+    losses = []
+    for _ in range(2):
+        raw = next(loader)
+        batch = shard_host_local(
+            {"imgs": raw["imgs"], "R": raw["R"], "T": raw["T"],
+             "K": raw["K"]}, env.batch())
+        state, metrics = step_fn(state, batch, rng)
+        losses.append(float(jax.device_get(metrics["loss"])))
+
+    assert is_primary() == (pid == 0)
+    with open(os.path.join(out_dir, f"loss_{pid}.json"), "w") as f:
+        json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""REAL multi-process distributed test: a 2-process CPU 'pod' (2 virtual
+devices per process, 4 global) runs the sharded train step end-to-end.
+
+This is the multi-host story the reference never tested anywhere
+(README.md:14 'Yet to test'; SURVEY.md §4): here it runs in CI on any
+machine.  Covers jax.distributed bring-up, cross-process gradient
+all-reduce compiled from shardings, per-host global-batch assembly
+(``shard_host_local``'s ``make_array_from_process_local_data`` branch),
+and identical loss trajectories on every process.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_train_step(tmp_path):
+    port = _free_port()
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_PLATFORMS="cpu",
+        # Fresh per-run cache: if one worker AOT-loads a cached executable
+        # while the other compiles, they create different gloo-context
+        # sequences and the collective rendezvous times out.  An empty
+        # shared dir keeps both workers symmetric (both compile).
+        JAX_COMPILATION_CACHE_DIR=str(tmp_path / "jax_cache"),
+    )
+    # Workers write straight to files: PIPE capture with sequential
+    # communicate() can deadlock (a worker blocking on a full unread pipe
+    # stalls the other inside a cross-process collective), and a timeout
+    # must still kill BOTH workers or they stay pinned on the rendezvous.
+    log_paths = [tmp_path / f"out_{pid}.log" for pid in (0, 1)]
+    logs = [open(p, "wb") for p in log_paths]
+    procs = []
+    try:
+        for pid in (0, 1):
+            procs.append(subprocess.Popen(
+                [sys.executable, _WORKER, str(pid), "2",
+                 f"localhost:{port}", str(tmp_path)],
+                env=env, stdout=logs[pid], stderr=subprocess.STDOUT))
+        for p in procs:
+            p.wait(timeout=840)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+    for p, lp in zip(procs, log_paths):
+        out = lp.read_text(errors="replace")
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    losses = [json.load(open(tmp_path / f"loss_{pid}.json"))
+              for pid in (0, 1)]
+    # Both processes observe the SAME global loss (one global batch, one
+    # all-reduced gradient) — the property the reference's DDP path lost.
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    assert all(np.isfinite(l) for l in losses[0]) and len(losses[0]) == 2
