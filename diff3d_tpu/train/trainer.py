@@ -105,11 +105,21 @@ class Trainer:
         reference's only recovery story is rerunning with ``--transfer``
         from the last 50-step save — ``train.py:238-251``.)"""
 
+        prev = {}
+
         def handler(signum, frame):
             log.warning("signal %d: checkpointing and stopping", signum)
             self._preempted.set()
+            # Chain whatever handler was installed before us — on pods,
+            # jax.distributed.initialize registers the preemption-sync
+            # notifier on SIGTERM, and clobbering it would leave
+            # reached_preemption_sync_point permanently False.
+            p = prev.get(signum)
+            if callable(p):
+                p(signum, frame)
 
         for s in signals:
+            prev[s] = signal.getsignal(s)
             signal.signal(s, handler)
 
     def _stop_requested(self, step: int) -> bool:
@@ -118,12 +128,20 @@ class Trainer:
         boundaries would split between a collective checkpoint save and a
         collective train step.  On multi-process runs the decision goes
         through the coordination service's preemption-sync protocol (any
-        host's notice propagates to all, and all agree on the same stop
-        step); the local flag feeds single-process runs and tests."""
+        host's notice propagates to all — our signal handler chains JAX's
+        notifier — and all hosts agree on the same stop step); the local
+        flag feeds single-process runs and tests."""
         if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+            try:
+                from jax.experimental import multihost_utils
 
-            return multihost_utils.reached_preemption_sync_point(step)
+                return multihost_utils.reached_preemption_sync_point(step)
+            except Exception:
+                # No preemption-sync manager in this runtime: the local
+                # flag is the only signal left.  Hosts may observe it at
+                # different steps — a hang risk, but strictly better than
+                # ignoring the preemption and losing the state entirely.
+                return self._preempted.is_set()
         return self._preempted.is_set()
 
     def _eval_step(self, state: TrainState, batch, rng):

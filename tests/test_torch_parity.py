@@ -1,0 +1,163 @@
+"""Numerical parity of Flax layers + the torch-checkpoint converter
+against PyTorch primitives.
+
+The reference model itself cannot be imported here (its visu3d dependency
+is not in the image), so these tests rebuild each block's documented
+semantics (SURVEY.md §2.1; reference ``xunet.py`` file:line cited per
+test) from raw torch primitives with random weights, convert those
+weights through :mod:`diff3d_tpu.convert.torch_ckpt`'s mapping helpers,
+and assert the Flax modules reproduce the torch outputs — validating both
+the layer math and the tensor-layout conversion (the two places silent
+parity bugs hide).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from diff3d_tpu.convert.torch_ckpt import (_attn_layer, _conv, _groupnorm,
+                                           _linear)
+from diff3d_tpu.models.layers import AttnLayer, FiLM, FrameGroupNorm
+
+torch.manual_seed(0)
+
+
+def _np(t):
+    return t.detach().cpu().numpy()
+
+
+def test_multihead_attention_matches_torch():
+    """AttnLayer (q/k/v/out projections + sdpa) vs
+    torch.nn.MultiheadAttention(batch_first=True) — reference
+    ``xunet.py:161`` — with packed in_proj weights converted."""
+    B, L, C, H = 2, 24, 32, 4
+    mha = torch.nn.MultiheadAttention(C, H, batch_first=True)
+    q = torch.randn(B, L, C)
+    kv = torch.randn(B, L, C)
+    ref, _ = mha(q, kv, kv, need_weights=False)
+
+    sd = {f"x.attn.{k}": _np(v) for k, v in mha.state_dict().items()}
+    params = _attn_layer(sd, "x")
+    out = AttnLayer(num_heads=H, attn_impl="xla").apply(
+        {"params": params}, jnp.asarray(_np(q)), jnp.asarray(_np(kv)))
+    np.testing.assert_allclose(np.asarray(out), _np(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_groupnorm_over_frames_matches_torch():
+    """FrameGroupNorm vs torch GN applied to frames folded into batch
+    (reference ``xunet.py:61-71``)."""
+    B, F, H, W, C = 2, 2, 6, 6, 32
+    gn = torch.nn.GroupNorm(8, C)
+    with torch.no_grad():
+        gn.weight.uniform_(0.5, 1.5)
+        gn.bias.uniform_(-0.5, 0.5)
+    x = torch.randn(B * F, C, H, W)
+    ref = gn(x)                                      # [B*F, C, H, W]
+
+    sd = {"g.gn.weight": _np(gn.weight), "g.gn.bias": _np(gn.bias)}
+    params = _groupnorm(sd, "g")
+    x_flax = jnp.asarray(_np(x)).transpose(0, 2, 3, 1).reshape(
+        B, F, H, W, C)
+    out = FrameGroupNorm(num_groups=8).apply({"params": params}, x_flax)
+    ref_nhwc = _np(ref).transpose(0, 2, 3, 1).reshape(B, F, H, W, C)
+    np.testing.assert_allclose(np.asarray(out), ref_nhwc,
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_film_matches_torch():
+    """FiLM: Linear(emb_ch -> 2*features) on SiLU(emb), h*(1+scale)+shift
+    (reference ``xunet.py:74-87``, which transposes around its Linear; the
+    channels-last layout here must be numerically identical)."""
+    B, F, H, W, C, E = 2, 2, 4, 4, 16, 24
+    dense = torch.nn.Linear(E, 2 * C)
+    h = torch.randn(B, F, C, H, W)
+    emb = torch.randn(B, F, E, H, W)
+
+    e = torch.nn.functional.silu(emb).permute(0, 1, 3, 4, 2)  # [...,E]
+    scale, shift = dense(e).chunk(2, dim=-1)                  # [...,C]
+    ref = (h.permute(0, 1, 3, 4, 2) * (1 + scale) + shift)    # [B,F,H,W,C]
+
+    sd = {"f.dense.weight": _np(dense.weight),
+          "f.dense.bias": _np(dense.bias)}
+    params = {"Dense_0": _linear(sd, "f.dense")}
+    out = FiLM(features=C).apply(
+        {"params": params},
+        jnp.asarray(_np(h.permute(0, 1, 3, 4, 2))),
+        jnp.asarray(_np(emb.permute(0, 1, 3, 4, 2))))
+    np.testing.assert_allclose(np.asarray(out), _np(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_conv3x3_layout_conversion():
+    """Conv2d [O,I,kh,kw] -> Flax [kh,kw,I,O] with SAME padding."""
+    import flax.linen as nn
+
+    conv = torch.nn.Conv2d(8, 16, 3, padding=1)
+    x = torch.randn(2, 8, 10, 10)
+    ref = conv(x)
+
+    sd = {"c.weight": _np(conv.weight), "c.bias": _np(conv.bias)}
+    params = _conv(sd, "c")
+    out = nn.Conv(16, (3, 3)).apply(
+        {"params": params}, jnp.asarray(_np(x.permute(0, 2, 3, 1))))
+    np.testing.assert_allclose(np.asarray(out),
+                               _np(ref.permute(0, 2, 3, 1)),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_resnet_block_matches_torch_composition():
+    """Full ResnetBlock vs the reference's documented composition
+    (``xunet.py:90-152``): GN -> SiLU -> conv1 -> GN -> FiLM -> conv2,
+    (+ 1x1-projected skip), /sqrt(2) — assembled from torch primitives
+    with shared weights."""
+    from diff3d_tpu.models.layers import ResnetBlock
+
+    B, F, H, W, Cin, Cout, E = 1, 2, 6, 6, 16, 32, 24
+    # FrameGroupNorm picks the largest group count <= 32 dividing C
+    # (reference hardcodes GN(32), xunet.py:65); match it here.
+    gn0 = torch.nn.GroupNorm(16, Cin)
+    gn1 = torch.nn.GroupNorm(32, Cout)
+    conv1 = torch.nn.Conv2d(Cin, Cout, 3, padding=1)
+    conv2 = torch.nn.Conv2d(Cout, Cout, 3, padding=1)
+    film = torch.nn.Linear(E, 2 * Cout)
+    skip = torch.nn.Conv2d(Cin, Cout, 1)
+    for m in (gn0, gn1):
+        with torch.no_grad():
+            m.weight.uniform_(0.5, 1.5)
+            m.bias.uniform_(-0.2, 0.2)
+
+    x = torch.randn(B * F, Cin, H, W)
+    emb = torch.randn(B, F, E)                       # broadcast per pixel
+
+    h = conv1(torch.nn.functional.silu(gn0(x)))
+    h = gn1(h)
+    e = torch.nn.functional.silu(emb)
+    scale, shift = film(e).chunk(2, dim=-1)          # [B, F, Cout]
+    sc = scale.reshape(B * F, Cout, 1, 1)
+    sh = shift.reshape(B * F, Cout, 1, 1)
+    h = h * (1 + sc) + sh
+    h = conv2(h)
+    ref = (h + skip(x)) / np.sqrt(2.0)
+
+    sd = {}
+    for name, mod in (("groupnorm0.gn", gn0), ("groupnorm1.gn", gn1),
+                      ("conv1", conv1), ("conv2", conv2),
+                      ("film.dense", film), ("dense", skip)):
+        for k, v in mod.state_dict().items():
+            sd[f"r.{name}.{k}"] = _np(v)
+
+    from diff3d_tpu.convert.torch_ckpt import _resnet_block
+    params = _resnet_block(sd, "r", has_skip_proj=True)
+
+    x_flax = jnp.asarray(_np(x.permute(0, 2, 3, 1))).reshape(
+        B, F, H, W, Cin)
+    emb_flax = jnp.broadcast_to(
+        jnp.asarray(_np(emb))[:, :, None, None, :], (B, F, H, W, E))
+    out = ResnetBlock(features=Cout, dropout=0.0).apply(
+        {"params": params}, x_flax, emb_flax, True)
+    ref_nhwc = _np(ref.permute(0, 2, 3, 1)).reshape(B, F, H, W, Cout)
+    np.testing.assert_allclose(np.asarray(out), ref_nhwc,
+                               atol=1e-4, rtol=1e-4)
