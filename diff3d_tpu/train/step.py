@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 
 from diff3d_tpu.config import Config
+from diff3d_tpu.data.images import dequantize
 from diff3d_tpu.diffusion import p_losses
 from diff3d_tpu.parallel import MeshEnv
 from diff3d_tpu.train.state import (TrainState, ema_decay_per_step,
@@ -59,10 +60,13 @@ def make_train_step(model, cfg: Config, env: MeshEnv | None = None,
                                    cond_mask=cond_mask, deterministic=False,
                                    rngs={"dropout": k_drop},
                                    constrain=constrain)
+            # Loader batches arrive as uint8 (data/images.py); the cast
+            # to [-1, 1] f32 happens here on device, fused by XLA.
             return p_losses(
-                denoise, batch["imgs"], batch["R"], batch["T"], batch["K"],
-                rng, cond_prob=dcfg.cond_prob, loss_type=dcfg.loss_type,
-                logsnr_min=dcfg.logsnr_min, logsnr_max=dcfg.logsnr_max)
+                denoise, dequantize(batch["imgs"]), batch["R"], batch["T"],
+                batch["K"], rng, cond_prob=dcfg.cond_prob,
+                loss_type=dcfg.loss_type, logsnr_min=dcfg.logsnr_min,
+                logsnr_max=dcfg.logsnr_max)
 
         return jax.value_and_grad(loss_fn)(params)
 

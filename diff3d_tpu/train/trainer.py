@@ -160,9 +160,10 @@ class Trainer:
                 def denoise(model_batch, cond_mask):
                     return self.model.apply({"params": params}, model_batch,
                                             cond_mask=cond_mask)
+                from diff3d_tpu.data.images import dequantize
                 return p_losses(
-                    denoise, batch["imgs"], batch["R"], batch["T"],
-                    batch["K"], rng, cond_prob=dcfg.cond_prob,
+                    denoise, dequantize(batch["imgs"]), batch["R"],
+                    batch["T"], batch["K"], rng, cond_prob=dcfg.cond_prob,
                     loss_type=dcfg.loss_type, logsnr_min=dcfg.logsnr_min,
                     logsnr_max=dcfg.logsnr_max)
 

@@ -148,3 +148,23 @@ def test_prefetch_propagates_producer_errors():
     next(it)
     with pytest.raises(RuntimeError, match="corrupt sample"):
         next(it)
+
+
+def test_loader_ships_uint8_and_roundtrips():
+    """Batches cross the host->device boundary as uint8 (4x less
+    transfer); dequantize recovers the float pipeline to within half a
+    quantization step."""
+    from diff3d_tpu.data.images import dequantize
+
+    ds = SyntheticDataset(num_objects=3, num_views=5, imgsize=8)
+    b_u8 = next(InfiniteLoader(ds, 4, seed=0, num_workers=0))
+    b_f32 = next(InfiniteLoader(ds, 4, seed=0, num_workers=0,
+                                images_uint8=False))
+    assert b_u8["imgs"].dtype == np.uint8
+    assert b_f32["imgs"].dtype == np.float32
+    assert b_u8["R"].dtype == np.float32        # only images quantize
+    back = dequantize(b_u8["imgs"])
+    assert back.dtype == np.float32
+    np.testing.assert_allclose(back, b_f32["imgs"], atol=1.01 / 255)
+    # float inputs pass through untouched
+    assert dequantize(b_f32["imgs"]) is b_f32["imgs"]
