@@ -9,9 +9,12 @@ scored against ground truth:
 
   * PSNR / SSIM per view at the sampler's guidance weight ``--w_index``
     (default 1, i.e. w=1 in the reference's 0..7 sweep), averaged.
-  * FID between the pooled generated views and the pooled GT views
-    (random-feature extractor by default; pass true Inception features
-    via the library API for paper-grade numbers).
+  * FID between the pooled generated views and the pooled GT views.
+    With ``--feature_weights <local VGG16 state dict>`` the real
+    VGG16-fc2 extractor is used and the number is reported as ``fid``;
+    without it the seeded random-projection fallback is used and the
+    number is reported as ``fid_randfeat`` — the key always says which
+    extractor produced the value (``evaluation/features.py``).
 
 Writes one JSON line to stdout and (optionally) ``--out`` JSONL.
 
@@ -31,9 +34,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", required=True,
                    help="checkpoint directory (Orbax root)")
-    p.add_argument("--val_data", required=True,
+    p.add_argument("--val_data", default=None,
                    help="SRN split dir (val objects are drawn from the "
                         "same 90/10 split the trainer used)")
+    p.add_argument("--synthetic_scenes", action="store_true",
+                   help="evaluate on the held-out ray-traced sphere "
+                        "scenes (seed=1, the same ones train_cli "
+                        "--synthetic_scenes validates on) instead of "
+                        "--val_data")
     p.add_argument("--picklefile", default=None)
     p.add_argument("--config", choices=["srn64", "srn128", "test"],
                    default="srn64")
@@ -45,9 +53,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="diffusion steps (reference: 256)")
     p.add_argument("--w_index", type=int, default=1,
                    help="guidance-sweep index scored for PSNR/SSIM/FID")
+    p.add_argument("--feature_weights", default=None,
+                   help="local VGG16 state-dict file (.pth/.pt/.npz, "
+                        "torchvision key names) for real-feature FID; "
+                        "omitted -> random-feature fallback, reported as "
+                        "fid_randfeat")
     p.add_argument("--raw_params", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=None, help="append JSONL here")
+    p.add_argument("--save_dir", default=None,
+                   help="dump gt/generated view PNGs here "
+                        "(<obj>/view{V}_{gt,gen}.png)")
     return p
 
 
@@ -65,6 +81,7 @@ def main(argv=None) -> None:
     from diff3d_tpu.data.srn import SRNDataset
     from diff3d_tpu.evaluation import (fid_from_stats, gaussian_stats, psnr,
                                        ssim)
+    from diff3d_tpu.evaluation.features import resolve_feature_fn
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.sampling import Sampler
     from diff3d_tpu.train import CheckpointManager, create_train_state
@@ -90,14 +107,22 @@ def main(argv=None) -> None:
     params = restored.params if args.raw_params else restored.ema_params
     step = int(restored.step)
 
-    ds = SRNDataset("val", args.val_data, args.picklefile,
-                    imgsize=cfg.model.H,
-                    split_seed=cfg.data.split_seed,
-                    train_fraction=cfg.data.train_fraction)
+    if args.synthetic_scenes:
+        from diff3d_tpu.data import SyntheticScenesDataset
+
+        ds = SyntheticScenesDataset(num_objects=max(8, args.objects),
+                                    imgsize=cfg.model.H, seed=1)
+    elif args.val_data:
+        ds = SRNDataset("val", args.val_data, args.picklefile,
+                        imgsize=cfg.model.H,
+                        split_seed=cfg.data.split_seed,
+                        train_fraction=cfg.data.train_fraction)
+    else:
+        raise SystemExit("pass --val_data or --synthetic_scenes")
     sampler = Sampler(model, params, cfg)
 
     rng = jax.random.PRNGKey(args.seed)
-    psnrs, ssims, gen_views, gt_views = [], [], [], []
+    psnrs, base_psnrs, ssims, gen_views, gt_views = [], [], [], [], []
     for obj in ds.ids[: args.objects]:
         views = ds.all_views(obj)
         rng, k = jax.random.split(rng)
@@ -108,20 +133,45 @@ def main(argv=None) -> None:
         gt = views["imgs"][1: 1 + gen.shape[0]]
         psnrs.extend(np.asarray(psnr(gen, gt)).tolist())
         ssims.extend(np.asarray(ssim(gen, gt)).tolist())
+        # copy-view-0 baseline: the score of ignoring the pose entirely
+        # and repeating the conditioning view — synthesis must beat this
+        copy0 = np.broadcast_to(views["imgs"][:1], gt.shape)
+        base_psnrs.extend(np.asarray(psnr(copy0, gt)).tolist())
         gen_views.append(gen)
         gt_views.append(gt)
-        logging.info("object %s: psnr %.2f", obj,
-                     float(np.mean(psnrs[-gen.shape[0]:])))
+        if args.save_dir:
+            import os
 
-    fid = fid_from_stats(gaussian_stats(gt_views),
-                         gaussian_stats(gen_views))
+            from PIL import Image
+
+            d = os.path.join(args.save_dir, str(obj))
+            os.makedirs(d, exist_ok=True)
+
+            def to_u8(img):
+                return ((np.clip(img, -1, 1) + 1) * 127.5).astype(np.uint8)
+
+            Image.fromarray(to_u8(views["imgs"][0])).save(
+                os.path.join(d, "view0_cond.png"))
+            for i in range(gen.shape[0]):
+                Image.fromarray(to_u8(gt[i])).save(
+                    os.path.join(d, f"view{i + 1}_gt.png"))
+                Image.fromarray(to_u8(gen[i])).save(
+                    os.path.join(d, f"view{i + 1}_gen.png"))
+        logging.info("object %s: psnr %.2f (copy-view-0 %.2f)", obj,
+                     float(np.mean(psnrs[-gen.shape[0]:])),
+                     float(np.mean(base_psnrs[-gen.shape[0]:])))
+
+    feature_fn, fid_key = resolve_feature_fn(args.feature_weights)
+    fid = fid_from_stats(gaussian_stats(gt_views, feature_fn),
+                         gaussian_stats(gen_views, feature_fn))
     record = {
         "checkpoint_step": step,
         "objects": len(gen_views),
         "views": len(psnrs),
         "psnr": round(float(np.mean(psnrs)), 3),
+        "psnr_copy_view0_baseline": round(float(np.mean(base_psnrs)), 3),
         "ssim": round(float(np.mean(ssims)), 4),
-        "fid_randfeat": round(float(fid), 3),
+        fid_key: round(float(fid), 3),
         "w_index": args.w_index,
         "timesteps": cfg.diffusion.timesteps,
     }

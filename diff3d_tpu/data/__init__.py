@@ -2,11 +2,13 @@ from diff3d_tpu.data.images import dequantize, quantize_uint8
 from diff3d_tpu.data.loader import InfiniteLoader, prefetch_to_device
 from diff3d_tpu.data.srn import (SRNDataset, build_index, load_intrinsics,
                                  load_object_views, load_pose, split_ids)
-from diff3d_tpu.data.synthetic import SyntheticDataset
+from diff3d_tpu.data.synthetic import (SyntheticDataset,
+                                       SyntheticScenesDataset)
 
 __all__ = [
     "SRNDataset", "build_index", "load_intrinsics", "load_object_views",
     "load_pose", "split_ids",
     "InfiniteLoader", "prefetch_to_device", "SyntheticDataset",
+    "SyntheticScenesDataset",
     "dequantize", "quantize_uint8",
 ]

@@ -14,13 +14,34 @@ step (resized pixels land off-grid by < 1/255 — invisible to training).
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
+
+log = logging.getLogger(__name__)
+_warned_out_of_range = False
 
 
 def quantize_uint8(imgs: np.ndarray) -> np.ndarray:
-    """Host-side ``[-1, 1] float`` -> ``[0, 255] uint8`` (round-to-nearest)."""
-    return np.clip((np.asarray(imgs) + 1.0) * 127.5 + 0.5,
-                   0, 255).astype(np.uint8)
+    """Host-side ``[-1, 1] float`` -> ``[0, 255] uint8`` (round-to-nearest).
+
+    Inputs are expected in [-1, 1]; anything outside (a future dataset or
+    augmentation with wider range / >8-bit precision) would be silently
+    clipped and quantized, so the first offending batch is logged.  Opt out
+    of uint8 transport per loader with ``InfiniteLoader(images_uint8=
+    False)`` for such data.
+    """
+    imgs = np.asarray(imgs)
+    global _warned_out_of_range
+    if not _warned_out_of_range:
+        lo, hi = float(imgs.min()), float(imgs.max())
+        if lo < -1.0001 or hi > 1.0001:
+            _warned_out_of_range = True
+            log.warning(
+                "quantize_uint8: input range [%.3f, %.3f] exceeds [-1, 1]; "
+                "values will be clipped (pass images_uint8=False to the "
+                "loader to keep full precision)", lo, hi)
+    return np.clip((imgs + 1.0) * 127.5 + 0.5, 0, 255).astype(np.uint8)
 
 
 def dequantize(imgs):

@@ -42,16 +42,48 @@ class InfiniteLoader:
     def __init__(self, dataset, batch_size: int, *, seed: int = 0,
                  host_id: int = 0, num_hosts: int = 1,
                  num_workers: int = 8, start_step: int = 0,
-                 images_uint8: bool = True):
+                 images_uint8: bool = True, sample_mode: str = "iid"):
+        """``sample_mode``:
+
+        * ``'iid'`` (default, training) — objects drawn independently with
+          replacement per slot;
+        * ``'permute'`` — without-replacement epoch permutations: global
+          draw ``g = (step*num_hosts + host) * batch_size + slot`` indexes
+          a per-epoch shuffle of the dataset, so every object is seen
+          exactly once per ``len(dataset)`` consecutive global draws (the
+          reference's epoch semantics, ``SRNdataset.py:12-40``) while
+          staying a pure function of ``(seed, step, host)``.  Default for
+          val loaders — no double-counted objects in small val splits.
+        """
+        if sample_mode not in ("iid", "permute"):
+            raise ValueError(f"unknown sample_mode {sample_mode!r}")
         self.dataset = dataset
         self.batch_size = batch_size
         self.seed = seed
         self.host_id = host_id
         self.num_hosts = num_hosts
         self.images_uint8 = images_uint8
+        self.sample_mode = sample_mode
         self._step = start_step
+        self._perm_cache: Dict[int, np.ndarray] = {}
         self._pool = (ThreadPoolExecutor(num_workers)
                       if num_workers > 0 else None)
+
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        perm = self._perm_cache.get(epoch)
+        if perm is None:
+            # Distinct ENTROPY (not just spawn_key) from the per-sample
+            # streams: _batch's root spawn((step, host)) children are
+            # (step, host, slot) keys over entropy=seed, so any key-only
+            # scheme could collide (spawn appends a child index).  The
+            # permutation is shared by all hosts.
+            rng = np.random.default_rng(np.random.SeedSequence(
+                entropy=(self.seed, 0x7065726D), spawn_key=(epoch,)))
+            perm = rng.permutation(len(self.dataset))
+            self._perm_cache[epoch] = perm
+            for old in sorted(self._perm_cache)[:-4]:
+                del self._perm_cache[old]
+        return perm
 
     def _batch(self, step: int) -> Dict[str, np.ndarray]:
         root = np.random.SeedSequence(
@@ -59,9 +91,19 @@ class InfiniteLoader:
         seqs = root.spawn(self.batch_size)
         n = len(self.dataset)
 
-        def one(seq):
+        if self.sample_mode == "permute":
+            g0 = (step * self.num_hosts + self.host_id) * self.batch_size
+            idxs = [int(self._epoch_perm((g0 + b) // n)[(g0 + b) % n])
+                    for b in range(self.batch_size)]
+        else:
+            idxs = [None] * self.batch_size
+
+        def one(args):
+            idx, seq = args
             rng = np.random.default_rng(seq)
-            s = self.dataset.sample(int(rng.integers(n)), rng)
+            if idx is None:
+                idx = int(rng.integers(n))
+            s = self.dataset.sample(idx, rng)
             if (self.images_uint8 and "imgs" in s
                     and s["imgs"].dtype != np.uint8):
                 # Per sample, inside the worker pool: the batch stacks
@@ -73,9 +115,9 @@ class InfiniteLoader:
             return s
 
         if self._pool is not None:
-            samples = list(self._pool.map(one, seqs))
+            samples = list(self._pool.map(one, zip(idxs, seqs)))
         else:
-            samples = [one(s) for s in seqs]
+            samples = [one(a) for a in zip(idxs, seqs)]
         return _collate(samples)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:

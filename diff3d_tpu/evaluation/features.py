@@ -1,0 +1,139 @@
+"""Real-network FID feature extractors (pluggable into ``fid.py``).
+
+The canonical FID feature space is a pretrained classifier's penultimate
+activations.  This zero-egress image ships NO pretrained weights, so the
+extractor takes a **local weights file** (``--feature_weights`` in
+``eval_cli``): a torchvision-format VGG16 ``state_dict`` saved as ``.pth``
+/``.pt`` (loaded via the baked-in cpu torch) or as an ``.npz`` with the
+same key names (``features.{i}.weight``, ``classifier.{i}.weight``, ...).
+The architecture is *inferred from the weight shapes* — conv widths, pool
+placement (index gaps in the ``features.*`` numbering), and input
+resolution (from ``classifier.0``'s fan-in) — so the same code runs the
+real 224x224 VGG16 and tiny parity-test networks.
+
+Feature definition: the 4096-d "fc2" embedding — ``classifier.3`` output
+after ReLU — a documented perceptual/FID feature space (VGG16 fc2).  Every
+number produced through here is labeled ``fid`` (vs the random-projection
+fallback's ``fid_randfeat``) so reports always say which extractor made
+them; see ``evaluation/fid.py`` and ``cli/eval_cli.py``.
+
+(The reference has no evaluation code at all — SURVEY.md §5.5.)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ImageNet normalization (torchvision transforms convention), applied to
+# [0, 1] inputs.
+_IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+_IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Load a torchvision-style state dict from ``.npz`` or ``.pth/.pt``."""
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):  # a full module was saved
+        sd = sd.state_dict()
+    return {k: v.detach().cpu().numpy() for k, v in sd.items()}
+
+
+def _vgg_spec(sd: Dict[str, np.ndarray]
+              ) -> Tuple[List[Tuple[int, bool]], int]:
+    """Infer (conv layer list, input size) from torchvision VGG key names.
+
+    Returns ``([(features_index, pool_after), ...], input_hw)``.  A gap of
+    3 between consecutive conv indices means conv->ReLU->MaxPool; a gap of
+    2 means conv->ReLU.  The trailing pool (torchvision puts one at the end
+    of ``features``) is always present.  Input resolution solves
+    ``classifier.0`` fan-in = C_last * s * s with s = hw / 2^n_pools.
+    """
+    idxs = sorted(int(m.group(1)) for k in sd
+                  if (m := re.fullmatch(r"features\.(\d+)\.weight", k)))
+    if not idxs or "classifier.0.weight" not in sd:
+        raise ValueError(
+            "weights are not a torchvision-style VGG state dict "
+            f"(conv indices {idxs}, keys {sorted(sd)[:5]}...)")
+    convs = []
+    for a, b in zip(idxs, idxs[1:]):
+        convs.append((a, b - a == 3))
+    convs.append((idxs[-1], True))
+    n_pools = sum(p for _, p in convs)
+    c_last = sd[f"features.{idxs[-1]}.weight"].shape[0]
+    fan_in = sd["classifier.0.weight"].shape[1]
+    s2, rem = divmod(fan_in, c_last)
+    s = int(round(np.sqrt(s2)))
+    if rem or s * s != s2:
+        raise ValueError(
+            f"classifier.0 fan-in {fan_in} is not c_last*s^2 (c={c_last})")
+    return convs, s * (2 ** n_pools)
+
+
+def vgg16_feature_fn(weights_path: str
+                     ) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Build a jittable ``[B, H, W, 3] in [-1, 1] -> [B, 4096]`` feature fn
+    from a local VGG16 weights file (see module docstring)."""
+    sd = load_state_dict(weights_path)
+    convs, input_hw = _vgg_spec(sd)
+
+    # Torch layouts -> XLA-native: conv OIHW -> HWIO, linear [out,in] kept
+    # (applied as x @ W.T).
+    params = {}
+    for i, _ in convs:
+        params[f"cw{i}"] = jnp.asarray(
+            np.transpose(sd[f"features.{i}.weight"], (2, 3, 1, 0)))
+        params[f"cb{i}"] = jnp.asarray(sd[f"features.{i}.bias"])
+    for i in (0, 3):
+        params[f"lw{i}"] = jnp.asarray(sd[f"classifier.{i}.weight"])
+        params[f"lb{i}"] = jnp.asarray(sd[f"classifier.{i}.bias"])
+    mean = jnp.asarray(_IMAGENET_MEAN)
+    std = jnp.asarray(_IMAGENET_STD)
+
+    def feats(imgs: jnp.ndarray) -> jnp.ndarray:
+        B = imgs.shape[0]
+        x = (imgs.astype(jnp.float32) + 1.0) / 2.0
+        x = jax.image.resize(x, (B, input_hw, input_hw, x.shape[-1]),
+                             "bilinear")
+        x = (x - mean) / std
+        for i, pool_after in convs:
+            x = jax.lax.conv_general_dilated(
+                x, params[f"cw{i}"], window_strides=(1, 1),
+                padding=((1, 1), (1, 1)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + params[f"cb{i}"])
+            if pool_after:
+                x = jax.lax.reduce_window(
+                    x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
+                    "VALID")
+        # classifier.0 fan-in is flattened NCHW (torch) order
+        x = jnp.transpose(x, (0, 3, 1, 2)).reshape(B, -1)
+        x = jax.nn.relu(x @ params["lw0"].T + params["lb0"])
+        x = jax.nn.relu(x @ params["lw3"].T + params["lb3"])
+        return x
+
+    return feats
+
+
+def resolve_feature_fn(weights_path=None):
+    """Returns ``(feature_fn, label)``: the real VGG16 extractor labeled
+    ``'fid'`` when a weights file exists, else the seeded random-projection
+    fallback labeled ``'fid_randfeat'`` (``fid.default_feature_fn``)."""
+    from diff3d_tpu.evaluation.fid import default_feature_fn
+
+    if weights_path:
+        if not os.path.exists(weights_path):
+            raise FileNotFoundError(
+                f"--feature_weights {weights_path} does not exist")
+        return vgg16_feature_fn(weights_path), "fid"
+    return default_feature_fn(), "fid_randfeat"

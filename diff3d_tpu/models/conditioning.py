@@ -107,8 +107,12 @@ class ConditioningProcessor(nn.Module):
         pose_embs = []
         for i_level in range(self.num_resolutions):
             s = 2 ** i_level
+            # Explicit (1, 1) padding = torch's padding=1 (reference
+            # xunet.py:292-299).  NOT "SAME": at stride >= 2 SAME aligns
+            # the sampling grid differently, which silently breaks
+            # converted-checkpoint parity at every level below the first.
             lvl = nn.Conv(self.emb_ch, (3, 3), strides=(s, s),
-                          padding="SAME", dtype=self.dtype,
+                          padding=((1, 1), (1, 1)), dtype=self.dtype,
                           name=f"level_conv_{i_level}")(flat)
             pose_embs.append(lvl.reshape(Bf, F, H // s, W // s, self.emb_ch))
 

@@ -56,7 +56,11 @@ class FrameGroupNorm(nn.Module):
     @nn.compact
     def __call__(self, h: jnp.ndarray) -> jnp.ndarray:
         B, F, H, W, C = h.shape
+        # epsilon matches torch.nn.GroupNorm's 1e-5 (reference xunet.py:66);
+        # Flax's default 1e-6 drifts ~1e-5/application across the ~40 GNs of
+        # a converted checkpoint's forward.
         out = nn.GroupNorm(num_groups=_num_groups(C, self.num_groups),
+                           epsilon=1e-5,
                            dtype=self.dtype)(h.reshape(B * F, H, W, C))
         return out.reshape(B, F, H, W, C)
 

@@ -25,7 +25,13 @@ def _resolve_auto(q: jnp.ndarray) -> str:
     tile, so at D=32/64 it wastes 4x/2x of every QK^T and PV matmul and
     XLA's fused attention wins; only lane-filling heads (D > 64) with
     sequences long enough that the materialised [L, L] logits' HBM traffic
-    dominates are worth the flash kernel."""
+    dominates are worth the flash kernel.
+
+    'auto' resolves from the PROCESS-DEFAULT backend, not from where the
+    computation is actually placed: a TPU-backed process tracing a
+    CPU-mesh program must pass ``impl='xla'`` explicitly (tests/conftest
+    and the dryrun pin the whole process to CPU instead, which also
+    resolves correctly)."""
     try:
         platform = jax.default_backend()
     except RuntimeError:  # no backend at trace time; be conservative

@@ -53,6 +53,17 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _out_struct(shape, dtype, like) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct carrying ``like``'s varying-manual-axes set, so the
+    kernels work inside ``shard_map`` with its default ``check_vma=True``
+    (the ring-attention engine path)."""
+    try:
+        vma = jax.typeof(like).vma
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):  # pragma: no cover - older jax
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def supports(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> bool:
     """Shapes/dtypes this kernel handles: ``[B, L, H, D]`` with D <= LANE."""
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
@@ -150,11 +161,11 @@ def _fwd_call(q, k, v, *, scale: float, Lq: int, Lk: int, interpret: bool,
     qo_spec = pl.BlockSpec((1, bq, LANE), lambda n, qi, ki: (n, qi, 0))
     kv_spec = pl.BlockSpec((1, bk, LANE), lambda n, qi, ki: (n, ki, 0))
     out_specs = [qo_spec]
-    out_shape = [jax.ShapeDtypeStruct((N, Lq_pad, LANE), q.dtype)]
+    out_shape = [_out_struct((N, Lq_pad, LANE), q.dtype, q)]
     if save_lse:
         out_specs.append(qo_spec)
         out_shape.append(
-            jax.ShapeDtypeStruct((N, Lq_pad, LANE), jnp.float32))
+            _out_struct((N, Lq_pad, LANE), jnp.float32, q))
 
     kernel = functools.partial(_fwd_kernel, scale=scale, Lk=Lk, block_k=bk,
                                save_lse=save_lse)
@@ -177,7 +188,7 @@ def _fwd_call(q, k, v, *, scale: float, Lq: int, Lk: int, interpret: bool,
 # backward
 # --------------------------------------------------------------------------
 
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
                      dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
                      Lk: int, block_k: int):
     qi = pl.program_id(2)
@@ -196,18 +207,20 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     lse = lse_ref[0][:, :1]                                # [bq, 1]
     # delta = rowsum(dO * O): block-local (LANE covers the whole head dim)
     delta = jnp.sum(do * o, axis=-1, keepdims=True)        # [bq, 1]
+    glse = glse_ref[0][:, :1]                              # [bq, 1]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     s = jnp.where(_key_mask(ki, block_k, Lk), s, NEG_INF)
     p = jnp.exp(s - lse)                                   # [bq, bk]
 
-    # dV += P^T dO ; dP = dO V^T ; dS = P*(dP - delta) ; dK += dS^T Q
+    # dV += P^T dO ; dP = dO V^T ; dS = P*(dP - delta + glse) ; dK += dS^T Q
+    # (glse is the lse-output cotangent: d lse_i / d s_ij = p_ij)
     dv_scr[...] += jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
+    ds = p * (dp - delta + glse) * scale
     dk_scr[...] += jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -217,7 +230,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
                    dq_ref, dq_scr, *, scale: float, Lk: int, block_k: int):
     ki = pl.program_id(2)
 
@@ -232,6 +245,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][:, :1]
     delta = jnp.sum(do * o, axis=-1, keepdims=True)
+    glse = glse_ref[0][:, :1]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -239,7 +253,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale                          # [bq, bk]
+    ds = p * (dp - delta + glse) * scale                   # [bq, bk]
     dq_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(ki == pl.num_programs(2) - 1)
@@ -247,7 +261,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, *, scale: float, Lq: int, Lk: int,
+def _bwd_call(q, k, v, o, lse, do, glse, *, scale: float, Lq: int, Lk: int,
               interpret: bool):
     N, Lq_pad, _ = q.shape
     Lk_pad = k.shape[1]
@@ -258,30 +272,31 @@ def _bwd_call(q, k, v, o, lse, do, *, scale: float, Lq: int, Lk: int,
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, Lk=Lk, block_k=bk),
         grid=(N, Lk_pad // bk, Lq_pad // bq),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, q_spec],
+        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, q_spec, q_spec],
         out_specs=[k_spec, k_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((N, Lk_pad, LANE), q.dtype),
-            jax.ShapeDtypeStruct((N, Lk_pad, LANE), q.dtype),
+            _out_struct((N, Lk_pad, LANE), q.dtype, q),
+            _out_struct((N, Lk_pad, LANE), q.dtype, q),
         ],
         scratch_shapes=[_vmem((bk, LANE)), _vmem((bk, LANE))],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )
-    dk, dv = dkdv(q, k, v, o, do, lse)
+    dk, dv = dkdv(q, k, v, o, do, lse, glse)
 
     q2_spec = pl.BlockSpec((1, bq, LANE), lambda n, qi, ki: (n, qi, 0))
     k2_spec = pl.BlockSpec((1, bk, LANE), lambda n, qi, ki: (n, ki, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, Lk=Lk, block_k=bk),
         grid=(N, Lq_pad // bq, Lk_pad // bk),
-        in_specs=[q2_spec, k2_spec, k2_spec, q2_spec, q2_spec, q2_spec],
+        in_specs=[q2_spec, k2_spec, k2_spec, q2_spec, q2_spec, q2_spec,
+                  q2_spec],
         out_specs=q2_spec,
-        out_shape=jax.ShapeDtypeStruct((N, Lq_pad, LANE), q.dtype),
+        out_shape=_out_struct((N, Lq_pad, LANE), q.dtype, q),
         scratch_shapes=[_vmem((bq, LANE))],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(q, k, v, o, do, lse, glse)
     return dq, dk, dv
 
 
@@ -313,6 +328,18 @@ def _run_fwd(q, k, v, scale: float, interpret: bool, save_lse: bool):
     return _unpad(o, B, H, Lq, D), (qp, kp, vp, o, lse)
 
 
+def _unpad_lse(lse, B, H, L):
+    """Lane-replicated ``[B*H, L_pad, LANE]`` -> ``[B, L, H]``."""
+    return jnp.moveaxis(lse[:, :L, 0].reshape(B, H, L), 1, 2)
+
+
+def _pad_lse(g, B, H, L, L_pad):
+    """``[B, L, H]`` -> lane-replicated ``[B*H, L_pad, LANE]``."""
+    g = jnp.moveaxis(g, 2, 1).reshape(B * H, L)
+    g = jnp.pad(g, ((0, 0), (0, L_pad - L)))
+    return jnp.broadcast_to(g[..., None], (B * H, L_pad, LANE))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, scale: float, interpret: bool):
     # Primal (inference) path: no residuals materialised.
@@ -330,13 +357,44 @@ def _flash_bwd(scale, interpret, res, g):
     qp, kp, vp, o, lse, (B, H, Lq, Lk, D) = res
     Lq_pad = qp.shape[1]
     dop = _pad_qkv(g, Lq_pad)
-    dq, dk, dv = _bwd_call(qp, kp, vp, o, lse, dop, scale=scale, Lq=Lq,
-                           Lk=Lk, interpret=interpret)
+    dq, dk, dv = _bwd_call(qp, kp, vp, o, lse, dop, jnp.zeros_like(lse),
+                           scale=scale, Lq=Lq, Lk=Lk, interpret=interpret)
     return (_unpad(dq, B, H, Lq, D), _unpad(dk, B, H, Lk, D),
             _unpad(dv, B, H, Lk, D))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_lse(q, k, v, scale: float, interpret: bool):
+    out, (_, _, _, _, lse) = _run_fwd(q, k, v, scale, interpret,
+                                      save_lse=True)
+    B, Lq, H, _ = q.shape
+    return out, _unpad_lse(lse, B, H, Lq)
+
+
+def _flash_lse_fwd(q, k, v, scale: float, interpret: bool):
+    out, (qp, kp, vp, o, lse) = _run_fwd(q, k, v, scale, interpret,
+                                         save_lse=True)
+    B, Lq, H, D = q.shape
+    return ((out, _unpad_lse(lse, B, H, Lq)),
+            (qp, kp, vp, o, lse, (B, H, Lq, k.shape[1], D)))
+
+
+def _flash_lse_bwd(scale, interpret, res, gs):
+    g_o, g_lse = gs
+    qp, kp, vp, o, lse, (B, H, Lq, Lk, D) = res
+    Lq_pad = qp.shape[1]
+    dop = _pad_qkv(g_o, Lq_pad)
+    glse = _pad_lse(g_lse.astype(jnp.float32), B, H, Lq, Lq_pad)
+    dq, dk, dv = _bwd_call(qp, kp, vp, o, lse, dop, glse, scale=scale,
+                           Lq=Lq, Lk=Lk, interpret=interpret)
+    return (_unpad(dq, B, H, Lq, D), _unpad(dk, B, H, Lk, D),
+            _unpad(dv, B, H, Lk, D))
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -358,3 +416,27 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         except RuntimeError:  # pragma: no cover
             interpret = True
     return _flash(q, k, v, scale, bool(interpret))
+
+
+def flash_attention_lse(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        scale: Optional[float] = None,
+                        interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp, ``(o [B, L, H, D], lse [B, L, H] float32)``.
+
+    This is the building block for blockwise/ring attention
+    (:func:`diff3d_tpu.parallel.ring_attention.ring_sdpa`): partial
+    attention outputs over KV shards combine exactly via
+    ``lse = logaddexp(lse1, lse2); o = o1*exp(lse1-lse) + o2*exp(lse2-lse)``.
+    Differentiable in both outputs (the lse cotangent folds into the
+    backward kernels' ``dS`` term).
+    """
+    assert supports(q, k, v), (q.shape, k.shape, v.shape, q.dtype)
+    if scale is None:
+        scale = float(1.0 / np.sqrt(q.shape[-1]))
+    if interpret is None:
+        try:
+            interpret = jax.devices()[0].platform != "tpu"
+        except RuntimeError:  # pragma: no cover
+            interpret = True
+    return _flash_lse(q, k, v, scale, bool(interpret))

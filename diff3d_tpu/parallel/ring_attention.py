@@ -7,16 +7,24 @@ standard schemes, both pure-JAX collectives so XLA schedules them on ICI:
 
 * :func:`ring_sdpa` — blockwise (flash-style) attention with the KV shard
   rotating around the mesh axis via ``lax.ppermute``; each of the
-  ``n_shards`` steps combines a local [L/n x L/n] attention block into
-  running (max, sum, acc) online-softmax state.  Memory per device is
-  O(L/n), compute overlaps with the ring transfer.
+  ``n_shards`` steps computes a local ``(o, lse)`` partial attention and
+  folds it into the running result via the exact log-sum-exp combine.
+  Memory per device is O(L/n), compute overlaps with the ring transfer.
+  The local block engine is the Pallas flash kernel
+  (:func:`diff3d_tpu.ops.pallas_attention.flash_attention_lse`) when the
+  shapes support it on TPU — nothing of size ``[L/n, L/n]`` touches HBM —
+  with an einsum fallback elsewhere.  This is the kernel's designed role:
+  the single-chip X-UNet shapes are XLA-fused-sdpa territory (measured —
+  see ops/attention._resolve_auto), long-context ring shards are where a
+  hand kernel pays.
 * :func:`ulysses_sdpa` — ``all_to_all`` reshards tokens->heads so each
   device holds ALL tokens for H/n heads, runs an ordinary (flash) sdpa,
   and reshards back.  Cheaper for moderate L when heads divide evenly.
 
 Both are drop-in sdpa cores over local shards ``[B, L/n, H, D]`` of a
 global ``[B, L, H, D]`` array inside ``shard_map``; exactness vs unsharded
-attention is covered by tests on the 8-device CPU mesh.
+attention (values AND grads, both engines) is covered by tests on the
+8-device CPU mesh.
 """
 
 from __future__ import annotations
@@ -27,28 +35,54 @@ import jax
 import jax.numpy as jnp
 
 
-def _block_stats(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                 scale: float):
-    """One KV-block attention: returns (m, l, acc) with
-    m/l ``[B, Lq, H]`` and acc ``[B, Lq, H, D]`` (un-normalised PV)."""
+def _block_olse_einsum(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                       scale: float):
+    """One KV-block attention: returns ``(o [B, Lq, H, D] float32,
+    lse [B, Lq, H] float32)``."""
     s = jnp.einsum("blhd,bmhd->blhm", q, k,
                    preferred_element_type=jnp.float32) * scale
-    m = jnp.max(s, axis=-1)
-    p = jnp.exp(s - m[..., None])
+    m = jax.lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("blhm,bmhd->blhd", p.astype(v.dtype), v,
-                     preferred_element_type=jnp.float32)
-    return m, l, acc
+    o = jnp.einsum("blhm,bmhd->blhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32) / l[..., None]
+    return o, m[..., 0] + jnp.log(l)
+
+
+def _block_olse_pallas(q, k, v, scale: float):
+    from diff3d_tpu.ops.pallas_attention import flash_attention_lse
+
+    o, lse = flash_attention_lse(q, k, v, scale=scale)
+    return o.astype(jnp.float32), lse
+
+
+def _pick_engine(q, k, v, impl: str):
+    if impl == "einsum":
+        return _block_olse_einsum
+    from diff3d_tpu.ops.pallas_attention import supports
+
+    if impl == "pallas":
+        assert supports(q, k, v), (q.shape, q.dtype)
+        return _block_olse_pallas
+    # 'auto': flash kernel wherever it lowers (TPU) and shapes qualify
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except RuntimeError:  # pragma: no cover
+        on_tpu = False
+    return (_block_olse_pallas if on_tpu and supports(q, k, v)
+            else _block_olse_einsum)
 
 
 def ring_sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-              axis_name: str, scale: Optional[float] = None) -> jnp.ndarray:
+              axis_name: str, scale: Optional[float] = None,
+              impl: str = "auto") -> jnp.ndarray:
     """Ring attention over a sharded token axis.
 
     Args:
       q, k, v: local shards ``[B, L/n, H, D]`` (token axis sharded over
         ``axis_name``); every query attends to every global key.
       axis_name: the mesh axis the sequence is sharded over.
+      impl: local block engine — 'auto' | 'pallas' | 'einsum'.
 
     Returns the local output shard ``[B, L/n, H, D]``.
     """
@@ -56,26 +90,25 @@ def ring_sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     perm = [(i, (i + 1) % n) for i in range(n)]
+    block = _pick_engine(q, k, v, impl)
 
-    m0, l0, acc0 = _block_stats(q, k, v, scale)
+    o0, lse0 = block(q, k, v, scale)
 
     def step(carry, _):
-        m, l, acc, k, v = carry
+        o, lse, k, v = carry
         # rotate KV to the next device while (logically) computing; XLA
-        # overlaps the ppermute with the einsums where profitable.
+        # overlaps the ppermute with the block attention where profitable.
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
-        bm, bl, bacc = _block_stats(q, k, v, scale)
-        m_new = jnp.maximum(m, bm)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(bm - m_new)
-        l = l * alpha + bl * beta
-        acc = acc * alpha[..., None] + bacc * beta[..., None]
-        return (m_new, l, acc, k, v), None
+        bo, blse = block(q, k, v, scale)
+        lse_new = jnp.logaddexp(lse, blse)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + bo * jnp.exp(blse - lse_new)[..., None])
+        return (o, lse_new, k, v), None
 
-    (m, l, acc, _, _), _ = jax.lax.scan(
-        step, (m0, l0, acc0, k, v), None, length=n - 1)
-    return (acc / l[..., None]).astype(q.dtype)
+    (o, _, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v), None,
+                                   length=n - 1)
+    return o.astype(q.dtype)
 
 
 def ulysses_sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

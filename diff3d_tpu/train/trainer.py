@@ -280,13 +280,20 @@ class Trainer:
                     saved_this_step = self.ckpt.save(self.state)
 
                 if (self.val_loader is not None and cfg.eval_every
-                        and step % cfg.eval_every == 0):
+                        and (step % cfg.eval_every == 0
+                             or step >= max_steps)):
                     vb = next(self.val_loader)
+                    # Distinct stream tag: the train step already consumes
+                    # fold_in(rng, step) (step.py), so fold an eval-only
+                    # constant on top to decorrelate val noise draws from
+                    # that step's train draws.
+                    eval_rng = jax.random.fold_in(
+                        jax.random.fold_in(self.rng, step), 0xE7A1)
                     vloss = float(self._eval_step(
                         self.state,
                         {"imgs": vb["imgs"], "R": vb["R"], "T": vb["T"],
                          "K": vb["K"]},
-                        jax.random.fold_in(self.rng, step)))
+                        eval_rng))
                     self._log({"step": step, "val_loss": vloss})
                     log.info("step %d val_loss %.4f", step, vloss)
 

@@ -30,3 +30,23 @@ assert jax.devices()[0].platform == "cpu", (
     "tests must run on the virtual CPU mesh, got "
     f"{jax.devices()[0].platform}")
 assert len(jax.devices()) == 8, len(jax.devices())
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run the slow end-to-end tests (test_cli, test_multiprocess)")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Keep the default ``pytest -q`` under ~5 min: the two end-to-end
+    files (train->sample CLI roundtrip, 2-process pod) are opt-in."""
+    if config.getoption("--runslow") or os.environ.get("RUN_SLOW"):
+        return
+    skip = pytest.mark.skip(
+        reason="slow end-to-end test; pass --runslow (or RUN_SLOW=1)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -37,6 +37,7 @@ def test_sample_cli_flag_parity():
     assert args.model == "/ckpt" and args.target == "/obj"
 
 
+@pytest.mark.slow
 def test_train_then_sample_cli_end_to_end(tmp_path):
     """Smoke the full user path: train 2 steps on synthetic data, then
     sample from the checkpoint (test config, tiny shapes)."""
@@ -75,6 +76,7 @@ def test_train_then_sample_cli_end_to_end(tmp_path):
     assert os.path.exists(os.path.join(out, "1", "0.png"))
 
 
+@pytest.mark.slow
 def test_eval_cli_end_to_end(tmp_path, capsys):
     """Train 2 steps, then score PSNR/SSIM/FID on a fake val object."""
     from diff3d_tpu.cli import eval_cli

@@ -57,6 +57,46 @@ def test_backward_matches_xla(shape):
         np.testing.assert_allclose(b, a, atol=5e-2, rtol=5e-2)
 
 
+@pytest.mark.parametrize("shape", SHAPES[:2] + SHAPES[3:])
+def test_lse_output_matches_logsumexp(shape):
+    from diff3d_tpu.ops.pallas_attention import flash_attention_lse
+
+    q, k, v = _qkv(shape)
+    o, lse = flash_attention_lse(q, k, v, interpret=True)
+    np.testing.assert_allclose(o, jax.nn.dot_product_attention(q, k, v),
+                               atol=1e-2, rtol=1e-2)
+    D = q.shape[-1]
+    s = jnp.einsum("blhd,bmhd->blhm", q, k) / np.sqrt(D)
+    ref_lse = jax.scipy.special.logsumexp(s, axis=-1)     # [B, Lq, H]
+    assert lse.shape == ref_lse.shape
+    np.testing.assert_allclose(lse, ref_lse, atol=1e-3, rtol=1e-3)
+
+
+def test_lse_gradients_including_lse_cotangent():
+    """Both outputs' cotangents flow: compare against autodiff of the
+    same (attention, logsumexp) pair composed from jnp primitives."""
+    from diff3d_tpu.ops.pallas_attention import flash_attention_lse
+
+    q, k, v = _qkv((1, 64, 64, 2, 32), seed=3)
+    D = q.shape[-1]
+
+    def ref_fn(q, k, v):
+        s = jnp.einsum("blhd,bmhd->blhm", q, k) / np.sqrt(D)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("blhm,bmhd->blhd", p, v)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    def fl_fn(q, k, v):
+        o, lse = flash_attention_lse(q, k, v, interpret=True)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+    g_ref = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(fl_fn, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(b, a, atol=5e-3, rtol=5e-3)
+
+
 def test_bf16_forward():
     q, k, v = _qkv((2, 128, 128, 4, 64), dtype=jnp.bfloat16)
     ref = jax.nn.dot_product_attention(q, k, v)
