@@ -1,11 +1,13 @@
-"""Headline benchmark: training throughput on the reference's own config.
+"""Headline benchmark: ALL THREE of PARITY.md's performance claims in one
+JSON line.
 
+Primary metric — training throughput on the reference's own config.
 Reference baseline (``BASELINE.md``): 101K steps in 120h on 8x RTX 3090 at
 SRN Cars 64x64, global batch 128 — 0.2338 train steps/s = 29.9 examples/s.
 This bench times the same workload — X-UNet(H=64, W=64, ch=128), full
 train step (loss, grad, Adam, EMA), bf16 compute + per-block remat — on
 whatever devices are attached (one TPU chip under the driver; the mesh
-scales the same program to a pod) and prints ONE JSON line.
+scales the same program to a pod).
 
 ``vs_baseline`` compares **examples/s** against the reference's 29.9: the
 hardware differs (8 GPUs there, whatever is attached here), so throughput,
@@ -13,6 +15,19 @@ not step cadence, is the comparable quantity.  The global batch adapts
 downward (128 -> 64 -> 32 per try) if the attached HBM can't hold the
 reference's 128 — a single v5e is ~1/8 the memory of the reference's 8-GPU
 rig that the 128-batch config was sized for.
+
+The same JSON line also carries (on accelerator platforms):
+
+  * ``srn128`` — train examples/s at the paper's 128^2 config, which the
+    reference could not run at all (OOM on 8x3090, README.md:39);
+    ``vs_baseline`` is null because the reference has no number to beat.
+  * ``sampler`` — seconds per synthesised novel view at the reference
+    sampler's exact config (256 steps x 2-in-1 CFG forwards x 8-weight
+    guidance sweep, ``/root/reference/sampling.py:130-158``); the
+    reference published no timing, so ``vs_baseline`` is null.
+
+Sub-benches that fail (e.g. tunnel compile-helper limits) degrade to an
+``error`` note instead of killing the primary metric.
 """
 
 from __future__ import annotations
@@ -26,17 +41,18 @@ BASELINE_STEPS_PER_SEC = 101_000 / (120 * 3600)   # 8x3090, README.md:39
 BASELINE_EXAMPLES_PER_SEC = BASELINE_STEPS_PER_SEC * 128
 
 
-def _run(global_batch: int, n_steps: int, accum: int = 1):
+def _run(global_batch: int, n_steps: int, accum: int = 1,
+         config: str = "srn64"):
     import jax
 
-    from diff3d_tpu.config import srn64_config
+    from diff3d_tpu.config import srn64_config, srn128_config
     from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.parallel import make_mesh
     from diff3d_tpu.train import create_train_state, make_train_step
     from diff3d_tpu.train.trainer import init_params
 
-    cfg = srn64_config()
+    cfg = {"srn64": srn64_config, "srn128": srn128_config}[config]()
     cfg = dataclasses.replace(
         cfg,
         model=dataclasses.replace(cfg.model, remat=True),
@@ -74,22 +90,9 @@ def _run(global_batch: int, n_steps: int, accum: int = 1):
     return n_steps / (time.perf_counter() - t0)
 
 
-def main() -> None:
-    import jax
-
-    try:  # persistent compile cache across driver rounds
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-    except Exception:  # pragma: no cover
-        pass
-
-    platform = jax.devices()[0].platform
-    # Configs in preference order: the reference's exact global batch 128
-    # (2 accumulation microbatches fit one 16G chip), then direct smaller
-    # batches.  CPU fallback (no accelerator): tiny so the bench finishes.
-    configs = ([(128, 2), (64, 1), (32, 1)] if platform != "cpu"
-               else [(8, 1)])
-    n_steps = 10 if platform != "cpu" else 3
-
+def _train_bench(configs, n_steps: int, config: str):
+    """Try ``(global_batch, accum)`` configs in order; returns
+    ``(examples_per_sec, global_batch, accum)``."""
     steps_per_sec, global_batch, accum, err = None, None, 1, None
     for global_batch, accum in configs:
         # The tunneled compile helper dies transiently on big programs;
@@ -98,7 +101,7 @@ def main() -> None:
         # config.  Other INTERNAL errors are real failures and propagate.
         for attempt in (0, 1):
             try:
-                steps_per_sec = _run(global_batch, n_steps, accum)
+                steps_per_sec = _run(global_batch, n_steps, accum, config)
                 break
             except Exception as e:
                 msg = str(e)
@@ -113,7 +116,8 @@ def main() -> None:
                 # batch) and their HBM buffers across the retry.
                 err = msg.splitlines()[0]
                 retrying = compile_helper_died and attempt == 0
-                print(f"bench: b{global_batch}x{accum} failed ({err}); "
+                print(f"bench[{config}]: b{global_batch}x{accum} failed "
+                      f"({err}); "
                       + ("retrying" if retrying else "trying next config"),
                       file=sys.stderr)
                 if not retrying:
@@ -121,18 +125,103 @@ def main() -> None:
         if steps_per_sec is not None:
             break
     if steps_per_sec is None:
-        raise SystemExit(f"bench failed at every batch size: {err}")
+        raise RuntimeError(f"all batch sizes failed: {err}")
+    return steps_per_sec * global_batch, global_batch, accum
 
-    examples_per_sec = steps_per_sec * global_batch
+
+def _sampler_bench():
+    """Seconds per synthesised view, reference sampler config (256 steps,
+    8-weight guidance sweep, 64^2) — one compiled lax.scan per view."""
+    import jax
+    import numpy as np
+
+    from diff3d_tpu.config import srn64_config
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.sampling.runtime import Sampler
+    from diff3d_tpu.train.trainer import init_params
+
+    cfg = srn64_config()
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    sampler = Sampler(model, init_params(model, cfg, rng), cfg)
+
+    rs = np.random.RandomState(0)
+    n_views = 4
+    views = {
+        "imgs": rs.randn(n_views, cfg.model.H, cfg.model.W,
+                         3).astype(np.float32),
+        "R": np.broadcast_to(np.eye(3, dtype=np.float32),
+                             (n_views, 3, 3)).copy(),
+        "T": rs.randn(n_views, 3).astype(np.float32),
+        "K": np.array([[64 * 1.2, 0, 32], [0, 64 * 1.2, 32], [0, 0, 1]],
+                      np.float32),
+    }
+    # Warmup (compile) at the SAME record-buffer capacity as the timed run;
+    # synthesize returns host arrays, so timing is value-fetch-synced.
+    sampler.synthesize(views, rng, max_views=n_views)
+    t0 = time.perf_counter()
+    sampler.synthesize(views, rng, max_views=n_views)
+    return (time.perf_counter() - t0) / (n_views - 1)
+
+
+def main() -> None:
+    import jax
+
+    try:  # persistent compile cache across driver rounds
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    except Exception:  # pragma: no cover
+        pass
+
+    platform = jax.devices()[0].platform
+    ndev = len(jax.devices())
+    on_accel = platform != "cpu"
+    # srn64 configs in preference order: the reference's exact global batch
+    # 128 (2 accumulation microbatches fit one 16G chip), then direct
+    # smaller batches.  CPU fallback (no accelerator): tiny so the bench
+    # finishes.
+    configs = [(128, 2), (64, 1), (32, 1)] if on_accel else [(8, 1)]
+    n_steps = 10 if on_accel else 3
+
+    examples_per_sec, global_batch, accum = _train_bench(
+        configs, n_steps, "srn64")
     name = f"b{global_batch}" + (f"x{accum}accum" if accum > 1 else "")
-    print(json.dumps({
+    payload = {
         "metric": f"train_examples_per_sec_srn64_{name}_{platform}"
-                  f"_x{len(jax.devices())}",
+                  f"_x{ndev}",
         "value": round(examples_per_sec, 2),
         "unit": "examples/s",
         "vs_baseline": round(examples_per_sec / BASELINE_EXAMPLES_PER_SEC,
                              4),
-    }))
+    }
+
+    # Secondary headline metrics ride in the same JSON line; CPU runs skip
+    # them (a 128^2 CPU compile + 256-step sampler adds many minutes for
+    # numbers nobody compares).
+    if on_accel:
+        try:
+            eps128, gb128, ac128 = _train_bench([(16, 4), (8, 4)], 5,
+                                                "srn128")
+            payload["srn128"] = {
+                "metric": f"train_examples_per_sec_srn128_b{gb128}x"
+                          f"{ac128}accum_{platform}_x{ndev}",
+                "value": round(eps128, 2),
+                "unit": "examples/s",
+                "vs_baseline": None,   # reference OOMs at 128^2
+            }
+        except Exception as e:
+            payload["srn128"] = {"error": str(e).splitlines()[0][:200]}
+        try:
+            sec_per_view = _sampler_bench()
+            payload["sampler"] = {
+                "metric": f"sampler_sec_per_view_srn64_{platform}",
+                "value": round(sec_per_view, 2),
+                "unit": "s/view",
+                "vs_baseline": None,   # reference published no timing
+            }
+        except Exception as e:
+            payload["sampler"] = {"error": str(e).splitlines()[0][:200]}
+
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -95,6 +95,10 @@ def main(argv=None) -> None:
             cfg, diffusion=dataclasses.replace(cfg.diffusion,
                                                timesteps=args.steps))
 
+    # Fail fast on a bad --feature_weights path/file BEFORE the expensive
+    # sampling loop; the extractor itself is reused after the loop.
+    feature_fn, fid_key = resolve_feature_fn(args.feature_weights)
+
     model = XUNet(cfg.model)
     state = create_train_state(
         init_params(model, cfg, jax.random.PRNGKey(0)), cfg.train)
@@ -144,24 +148,21 @@ def main(argv=None) -> None:
 
             from PIL import Image
 
+            from diff3d_tpu.sampling.runtime import to_uint8
+
             d = os.path.join(args.save_dir, str(obj))
             os.makedirs(d, exist_ok=True)
-
-            def to_u8(img):
-                return ((np.clip(img, -1, 1) + 1) * 127.5).astype(np.uint8)
-
-            Image.fromarray(to_u8(views["imgs"][0])).save(
+            Image.fromarray(to_uint8(views["imgs"][0])).save(
                 os.path.join(d, "view0_cond.png"))
             for i in range(gen.shape[0]):
-                Image.fromarray(to_u8(gt[i])).save(
+                Image.fromarray(to_uint8(gt[i])).save(
                     os.path.join(d, f"view{i + 1}_gt.png"))
-                Image.fromarray(to_u8(gen[i])).save(
+                Image.fromarray(to_uint8(gen[i])).save(
                     os.path.join(d, f"view{i + 1}_gen.png"))
         logging.info("object %s: psnr %.2f (copy-view-0 %.2f)", obj,
                      float(np.mean(psnrs[-gen.shape[0]:])),
                      float(np.mean(base_psnrs[-gen.shape[0]:])))
 
-    feature_fn, fid_key = resolve_feature_fn(args.feature_weights)
     fid = fid_from_stats(gaussian_stats(gt_views, feature_fn),
                          gaussian_stats(gen_views, feature_fn))
     record = {

@@ -43,7 +43,9 @@ def pytest_addoption(parser):
 def pytest_collection_modifyitems(config, items):
     """Keep the default ``pytest -q`` under ~5 min: the two end-to-end
     files (train->sample CLI roundtrip, 2-process pod) are opt-in."""
-    if config.getoption("--runslow") or os.environ.get("RUN_SLOW"):
+    if (config.getoption("--runslow")
+            or os.environ.get("RUN_SLOW", "").lower() in ("1", "true",
+                                                          "yes")):
         return
     skip = pytest.mark.skip(
         reason="slow end-to-end test; pass --runslow (or RUN_SLOW=1)")
