@@ -38,10 +38,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="SRN split dir (val objects are drawn from the "
                         "same 90/10 split the trainer used)")
     p.add_argument("--synthetic_scenes", action="store_true",
-                   help="evaluate on the held-out ray-traced sphere "
-                        "scenes (seed=1, the same ones train_cli "
-                        "--synthetic_scenes validates on) instead of "
-                        "--val_data")
+                   help="evaluate on ray-traced sphere scenes instead of "
+                        "--val_data (default seed 1 = the held-out set "
+                        "train_cli --synthetic_scenes validates on)")
+    p.add_argument("--scenes_seed", type=int, default=1,
+                   help="scene generator seed for --synthetic_scenes "
+                        "(0 = the training scenes, 1 = held-out)")
+    p.add_argument("--ch", type=int, default=None,
+                   help="model width override — must match the trained "
+                        "checkpoint (see train_cli --ch)")
+    p.add_argument("--emb_ch", type=int, default=None)
+    p.add_argument("--num_res_blocks", type=int, default=None)
     p.add_argument("--picklefile", default=None)
     p.add_argument("--config", choices=["srn64", "srn128", "test"],
                    default="srn64")
@@ -72,6 +79,14 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
     logging.getLogger("absl").setLevel(logging.WARNING)
 
+    # Dataset-choice errors fire BEFORE model init + checkpoint restore
+    # (minutes on a slow device link).
+    if args.synthetic_scenes and args.val_data:
+        raise SystemExit(
+            "--synthetic_scenes and --val_data are mutually exclusive")
+    if not (args.synthetic_scenes or args.val_data):
+        raise SystemExit("pass --val_data or --synthetic_scenes")
+
     import dataclasses
 
     import jax
@@ -94,10 +109,18 @@ def main(argv=None) -> None:
         cfg = dataclasses.replace(
             cfg, diffusion=dataclasses.replace(cfg.diffusion,
                                                timesteps=args.steps))
+    model_over = {k: getattr(args, k)
+                  for k in ("ch", "emb_ch", "num_res_blocks")
+                  if getattr(args, k) is not None}
+    if model_over:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, **model_over))
 
     # Fail fast on a bad --feature_weights path/file BEFORE the expensive
-    # sampling loop; the extractor itself is reused after the loop.
+    # sampling loop; jit once here so the gt and gen stats passes share
+    # one compiled executable.
     feature_fn, fid_key = resolve_feature_fn(args.feature_weights)
+    feature_fn = jax.jit(feature_fn)
 
     model = XUNet(cfg.model)
     state = create_train_state(
@@ -115,14 +138,13 @@ def main(argv=None) -> None:
         from diff3d_tpu.data import SyntheticScenesDataset
 
         ds = SyntheticScenesDataset(num_objects=max(8, args.objects),
-                                    imgsize=cfg.model.H, seed=1)
-    elif args.val_data:
+                                    imgsize=cfg.model.H,
+                                    seed=args.scenes_seed)
+    else:
         ds = SRNDataset("val", args.val_data, args.picklefile,
                         imgsize=cfg.model.H,
                         split_seed=cfg.data.split_seed,
                         train_fraction=cfg.data.train_fraction)
-    else:
-        raise SystemExit("pass --val_data or --synthetic_scenes")
     sampler = Sampler(model, params, cfg)
 
     rng = jax.random.PRNGKey(args.seed)

@@ -58,7 +58,10 @@ def gaussian_stats(batches: Iterable[np.ndarray],
                    feature_fn: Optional[Callable] = None) -> FIDStats:
     """Streaming mean/cov of features over image batches ``[B, H, W, C]``."""
     feature_fn = feature_fn or default_feature_fn()
-    f = jax.jit(feature_fn)
+    # don't re-wrap an already-jitted extractor (callers jit once and
+    # reuse the executable across the real/generated stats passes)
+    f = (feature_fn if isinstance(feature_fn, jax.stages.Wrapped)
+         else jax.jit(feature_fn))
     s = None
     for batch in batches:
         x = np.asarray(f(jnp.asarray(batch)), np.float64)
