@@ -149,6 +149,7 @@ def main(argv=None) -> None:
 
     rng = jax.random.PRNGKey(args.seed)
     psnrs, base_psnrs, ssims, gen_views, gt_views = [], [], [], [], []
+    per_w_psnrs = None
     for obj in ds.ids[: args.objects]:
         views = ds.all_views(obj)
         rng, k = jax.random.split(rng)
@@ -157,7 +158,15 @@ def main(argv=None) -> None:
             continue
         gen = out[:, args.w_index]                 # [V-1, H, W, 3]
         gt = views["imgs"][1: 1 + gen.shape[0]]
-        psnrs.extend(np.asarray(psnr(gen, gt)).tolist())
+        # the guidance sweep is the batch axis — score every w while the
+        # samples are in hand (picking w after the fact is free); the
+        # headline psnr list reuses the w_index column
+        if per_w_psnrs is None:
+            per_w_psnrs = [[] for _ in range(out.shape[1])]
+        for wi in range(out.shape[1]):
+            per_w_psnrs[wi].extend(
+                np.asarray(psnr(out[:, wi], gt)).tolist())
+        psnrs.extend(per_w_psnrs[args.w_index][-gen.shape[0]:])
         ssims.extend(np.asarray(ssim(gen, gt)).tolist())
         # copy-view-0 baseline: the score of ignoring the pose entirely
         # and repeating the conditioning view — synthesis must beat this
@@ -193,6 +202,7 @@ def main(argv=None) -> None:
         "views": len(psnrs),
         "psnr": round(float(np.mean(psnrs)), 3),
         "psnr_copy_view0_baseline": round(float(np.mean(base_psnrs)), 3),
+        "psnr_per_w": [round(float(np.mean(p)), 3) for p in per_w_psnrs],
         "ssim": round(float(np.mean(ssims)), 4),
         fid_key: round(float(fid), 3),
         "w_index": args.w_index,

@@ -116,6 +116,34 @@ def test_vgg_feature_fn_matches_torch_composed_forward(tmp_path):
     np.testing.assert_allclose(ours, theirs, atol=1e-5)
 
 
+def test_vgg_spec_inference_on_real_vgg16_layout():
+    """_vgg_spec must recover torchvision vgg16's exact structure: conv
+    indices 0,2,5,7,10,12,14,17,19,21,24,26,28; a pool follows convs
+    2,7,14,21,28 (each block's last conv); classifier.0 fan-in 512*7*7
+    -> input 224."""
+    from diff3d_tpu.evaluation.features import _vgg_spec
+
+    widths = [64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512,
+              512]
+    idxs = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]
+    sd = {}
+    cin = 3
+    for i, w in zip(idxs, widths):
+        sd[f"features.{i}.weight"] = np.zeros((w, cin, 3, 3), np.float32)
+        sd[f"features.{i}.bias"] = np.zeros((w,), np.float32)
+        cin = w
+    sd["classifier.0.weight"] = np.zeros((4096, 512 * 7 * 7), np.float32)
+    sd["classifier.0.bias"] = np.zeros((4096,), np.float32)
+    sd["classifier.3.weight"] = np.zeros((4096, 4096), np.float32)
+    sd["classifier.3.bias"] = np.zeros((4096,), np.float32)
+
+    convs, input_hw = _vgg_spec(sd)
+    assert input_hw == 224
+    pools_after = [i for i, p in convs if p]
+    assert pools_after == [2, 7, 14, 21, 28]   # last conv of each block
+    assert [i for i, _ in convs] == idxs
+
+
 def test_resolve_feature_fn_labels_and_npz_roundtrip(tmp_path):
     from diff3d_tpu.evaluation.features import resolve_feature_fn
 
