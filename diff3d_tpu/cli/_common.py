@@ -1,0 +1,36 @@
+"""Shared CLI plumbing.
+
+The three checkpoint-consuming CLIs (train resume, sample, eval) must
+rebuild the exact ``ModelConfig`` a checkpoint was trained with; the
+width knobs that change the parameter tree's shape live here so a new
+knob lands in every CLI at once.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+_WIDTH_KEYS = ("ch", "emb_ch", "num_res_blocks")
+
+
+def add_model_width_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--ch", type=int, default=None,
+                   help="base channel width — must match the trained "
+                        "checkpoint (reference: 128 at 64^2, "
+                        "xunet.py:229; smaller widths train/checkpoint "
+                        "faster on slow dev links)")
+    p.add_argument("--emb_ch", type=int, default=None,
+                   help="conditioning embedding width (reference: 1024)")
+    p.add_argument("--num_res_blocks", type=int, default=None,
+                   help="res blocks per UNet level (reference: 3)")
+
+
+def apply_model_width_overrides(cfg, args):
+    """Returns ``cfg`` with any of --ch/--emb_ch/--num_res_blocks applied."""
+    over = {k: getattr(args, k) for k in _WIDTH_KEYS
+            if getattr(args, k) is not None}
+    if not over:
+        return cfg
+    return dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, **over))

@@ -44,11 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scenes_seed", type=int, default=1,
                    help="scene generator seed for --synthetic_scenes "
                         "(0 = the training scenes, 1 = held-out)")
-    p.add_argument("--ch", type=int, default=None,
-                   help="model width override — must match the trained "
-                        "checkpoint (see train_cli --ch)")
-    p.add_argument("--emb_ch", type=int, default=None)
-    p.add_argument("--num_res_blocks", type=int, default=None)
+    from diff3d_tpu.cli._common import add_model_width_args
+    add_model_width_args(p)
     p.add_argument("--picklefile", default=None)
     p.add_argument("--config", choices=["srn64", "srn128", "test"],
                    default="srn64")
@@ -109,12 +106,8 @@ def main(argv=None) -> None:
         cfg = dataclasses.replace(
             cfg, diffusion=dataclasses.replace(cfg.diffusion,
                                                timesteps=args.steps))
-    model_over = {k: getattr(args, k)
-                  for k in ("ch", "emb_ch", "num_res_blocks")
-                  if getattr(args, k) is not None}
-    if model_over:
-        cfg = dataclasses.replace(
-            cfg, model=dataclasses.replace(cfg.model, **model_over))
+    from diff3d_tpu.cli._common import apply_model_width_overrides
+    cfg = apply_model_width_overrides(cfg, args)
 
     # Fail fast on a bad --feature_weights path/file BEFORE the expensive
     # sampling loop; jit once here so the gt and gen stats passes share
@@ -160,13 +153,14 @@ def main(argv=None) -> None:
         gt = views["imgs"][1: 1 + gen.shape[0]]
         # the guidance sweep is the batch axis — score every w while the
         # samples are in hand (picking w after the fact is free); the
-        # headline psnr list reuses the w_index column
+        # headline psnr list reuses this object's w_index column
+        obj_w_psnrs = [np.asarray(psnr(out[:, wi], gt)).tolist()
+                       for wi in range(out.shape[1])]
         if per_w_psnrs is None:
             per_w_psnrs = [[] for _ in range(out.shape[1])]
-        for wi in range(out.shape[1]):
-            per_w_psnrs[wi].extend(
-                np.asarray(psnr(out[:, wi], gt)).tolist())
-        psnrs.extend(per_w_psnrs[args.w_index][-gen.shape[0]:])
+        for wi, vals in enumerate(obj_w_psnrs):
+            per_w_psnrs[wi].extend(vals)
+        psnrs.extend(obj_w_psnrs[args.w_index])
         ssims.extend(np.asarray(ssim(gen, gt)).tolist())
         # copy-view-0 baseline: the score of ignoring the pose entirely
         # and repeating the conditioning view — synthesis must beat this
