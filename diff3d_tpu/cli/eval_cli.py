@@ -29,6 +29,9 @@ import argparse
 import json
 import logging
 
+from diff3d_tpu.cli._common import (add_model_width_args,
+                                    apply_model_width_overrides)
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
@@ -44,7 +47,6 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scenes_seed", type=int, default=1,
                    help="scene generator seed for --synthetic_scenes "
                         "(0 = the training scenes, 1 = held-out)")
-    from diff3d_tpu.cli._common import add_model_width_args
     add_model_width_args(p)
     p.add_argument("--picklefile", default=None)
     p.add_argument("--config", choices=["srn64", "srn128", "test"],
@@ -106,7 +108,6 @@ def main(argv=None) -> None:
         cfg = dataclasses.replace(
             cfg, diffusion=dataclasses.replace(cfg.diffusion,
                                                timesteps=args.steps))
-    from diff3d_tpu.cli._common import apply_model_width_overrides
     cfg = apply_model_width_overrides(cfg, args)
 
     # Fail fast on a bad --feature_weights path/file BEFORE the expensive

@@ -16,6 +16,9 @@ import argparse
 import logging
 import os
 
+from diff3d_tpu.cli._common import (add_model_width_args,
+                                    apply_model_width_overrides)
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
@@ -32,7 +35,6 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--raw_params", action="store_true",
                    help="sample with raw params instead of EMA")
     p.add_argument("--seed", type=int, default=0)
-    from diff3d_tpu.cli._common import add_model_width_args
     add_model_width_args(p)
     return p
 
@@ -60,7 +62,6 @@ def main(argv=None) -> None:
         cfg = dataclasses.replace(
             cfg, diffusion=dataclasses.replace(cfg.diffusion,
                                                timesteps=args.steps))
-    from diff3d_tpu.cli._common import apply_model_width_overrides
     cfg = apply_model_width_overrides(cfg, args)
 
     model = XUNet(cfg.model)

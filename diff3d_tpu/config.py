@@ -199,11 +199,22 @@ def srn128_config() -> Config:
     return Config(model=ModelConfig(H=128, W=128, ch=256, remat=True))
 
 
-def test_config(imgsize: int = 16, ch: int = 8) -> Config:
-    """Tiny config for unit tests / CPU-mesh dry runs."""
+def test_config(imgsize: int = 16, ch: int = 8,
+                shallow: bool = False) -> Config:
+    """Tiny config for unit tests / CPU-mesh dry runs.
+
+    ``shallow=True`` uses a 2-level UNet (vs the reference's 4) — half
+    the blocks to compile.  For tests of *properties that don't depend on
+    depth* (sharded==replicated equality, NaN guards, accumulation);
+    structure-sensitive tests (up-path bookkeeping, whole-model torch
+    parity, the driver dryrun) keep the full 4-level shape.
+    """
+    model_kw = dict(H=imgsize, W=imgsize, ch=ch, emb_ch=32,
+                    num_res_blocks=1, dropout=0.0, dtype="float32")
+    if shallow:
+        model_kw.update(ch_mult=(1, 2), attn_levels=(1, 2))
     return Config(
-        model=ModelConfig(H=imgsize, W=imgsize, ch=ch, emb_ch=32,
-                          num_res_blocks=1, dropout=0.0, dtype="float32"),
+        model=ModelConfig(**model_kw),
         train=TrainConfig(global_batch=8, warmup_examples=1024,
                           max_steps=4, ckpt_every=2, log_every=1),
         data=DataConfig(imgsize=imgsize),

@@ -17,7 +17,13 @@ from diff3d_tpu.train.trainer import init_params
 
 
 def tiny_cfg(**train_kw):
-    cfg = make_tiny_config(imgsize=8, ch=8)
+    # shallow 2-level UNet: these tests assert train-step PROPERTIES
+    # (equality across shardings, NaN guards, accumulation, resume),
+    # none of which depend on UNet depth — and it halves the dominant
+    # cost of this file, XLA-compiling ~20 block graphs per mesh config.
+    # Depth-sensitive coverage lives in test_model / test_torch_parity /
+    # the driver dryrun, all on the full 4-level shape.
+    cfg = make_tiny_config(imgsize=8, ch=8, shallow=True)
     if train_kw:
         import dataclasses
         cfg = dataclasses.replace(
