@@ -47,6 +47,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scenes_seed", type=int, default=1,
                    help="scene generator seed for --synthetic_scenes "
                         "(0 = the training scenes, 1 = held-out)")
+    p.add_argument("--scene_objects", type=int, default=None,
+                   help="the --scene_objects count the model was TRAINED "
+                        "with; with --scenes_seed 0 ('the training "
+                        "scenes'), --objects beyond it were never seen in "
+                        "training and would skew a train-vs-heldout "
+                        "comparison, so that combination errors out")
+    p.add_argument("--object_batch", type=int, default=None,
+                   help="objects synthesised concurrently as one batched "
+                        "program (objects are independent; batching fills "
+                        "the chip — per-object scores match --object_batch "
+                        "1 to float tolerance).  Default: 8 at <=64^2, 2 "
+                        "above (the batched model call and the record "
+                        "buffer both scale with it; lower if OOM)")
     add_model_width_args(p)
     p.add_argument("--picklefile", default=None)
     p.add_argument("--config", choices=["srn64", "srn128", "test"],
@@ -85,6 +98,17 @@ def main(argv=None) -> None:
             "--synthetic_scenes and --val_data are mutually exclusive")
     if not (args.synthetic_scenes or args.val_data):
         raise SystemExit("pass --val_data or --synthetic_scenes")
+    if (args.synthetic_scenes and args.scenes_seed == 0
+            and args.scene_objects is not None
+            and args.objects > args.scene_objects):
+        raise SystemExit(
+            f"--scenes_seed 0 scores training scenes, but --objects "
+            f"{args.objects} exceeds the trained --scene_objects "
+            f"{args.scene_objects}: objects beyond the trained count were "
+            "never seen in training and would be mislabeled as 'train' "
+            "scores — lower --objects or drop --scene_objects")
+    if args.object_batch is not None and args.object_batch < 1:
+        raise SystemExit("--object_batch must be >= 1")
 
     import dataclasses
 
@@ -141,67 +165,133 @@ def main(argv=None) -> None:
                         train_fraction=cfg.data.train_fraction)
     sampler = Sampler(model, params, cfg)
 
+    if args.object_batch is None:
+        # The batched model call (N*2B examples) and the [N, capacity, B,
+        # H, W, 3] record buffer both scale with N; at 128^2 a full-width
+        # no-max_views eval would OOM at N=8, so the default stays shy
+        # there and the flag overrides.
+        args.object_batch = 8 if cfg.model.H <= 64 else 2
+        logging.info("object_batch auto -> %d (H=%d)", args.object_batch,
+                     cfg.model.H)
+
+    # Per-object keys are split off in object order BEFORE batching, so
+    # the scores are invariant to --object_batch (same key -> same
+    # per-object stream; see Sampler.synthesize_many).
     rng = jax.random.PRNGKey(args.seed)
+    objs = list(ds.ids[: args.objects])
+    obj_views, obj_keys = [], []
+    for obj in objs:
+        obj_views.append(ds.all_views(obj))
+        rng, k = jax.random.split(rng)
+        obj_keys.append(k)
+
+    def n_views_of(v) -> int:
+        n = int(v["imgs"].shape[0])
+        return min(n, args.max_views) if args.max_views else n
+
+    per_object = []
     psnrs, base_psnrs, ssims, gen_views, gt_views = [], [], [], [], []
     per_w_psnrs = None
-    for obj in ds.ids[: args.objects]:
-        views = ds.all_views(obj)
-        rng, k = jax.random.split(rng)
-        out = sampler.synthesize(views, k, max_views=args.max_views)
-        if out.shape[0] == 0:
-            continue
-        gen = out[:, args.w_index]                 # [V-1, H, W, 3]
-        gt = views["imgs"][1: 1 + gen.shape[0]]
-        # the guidance sweep is the batch axis — score every w while the
-        # samples are in hand (picking w after the fact is free); the
-        # headline psnr list reuses this object's w_index column
-        obj_w_psnrs = [np.asarray(psnr(out[:, wi], gt)).tolist()
-                       for wi in range(out.shape[1])]
-        if per_w_psnrs is None:
-            per_w_psnrs = [[] for _ in range(out.shape[1])]
-        for wi, vals in enumerate(obj_w_psnrs):
-            per_w_psnrs[wi].extend(vals)
-        psnrs.extend(obj_w_psnrs[args.w_index])
-        ssims.extend(np.asarray(ssim(gen, gt)).tolist())
-        # copy-view-0 baseline: the score of ignoring the pose entirely
-        # and repeating the conditioning view — synthesis must beat this
-        copy0 = np.broadcast_to(views["imgs"][:1], gt.shape)
-        base_psnrs.extend(np.asarray(psnr(copy0, gt)).tolist())
-        gen_views.append(gen)
-        gt_views.append(gt)
-        if args.save_dir:
-            import os
+    i = 0
+    while i < len(objs):
+        # chunk of <= object_batch consecutive objects with equal view
+        # counts (synthesize_many truncates to the batch minimum)
+        j, nv = i + 1, n_views_of(obj_views[i])
+        while (j < len(objs) and j - i < args.object_batch
+               and n_views_of(obj_views[j]) == nv):
+            j += 1
+        outs = sampler.synthesize_many(obj_views[i:j], obj_keys[i:j],
+                                       max_views=args.max_views)
+        for obj, views, out in zip(objs[i:j], obj_views[i:j], outs):
+            if out.shape[0] == 0:
+                continue
+            gen = out[:, args.w_index]                 # [V-1, H, W, 3]
+            gt = views["imgs"][1: 1 + gen.shape[0]]
+            # the guidance sweep is the batch axis — score every w while
+            # the samples are in hand (picking w after the fact is free);
+            # the headline psnr list reuses this object's w_index column
+            obj_w_psnrs = [np.asarray(psnr(out[:, wi], gt)).tolist()
+                           for wi in range(out.shape[1])]
+            if per_w_psnrs is None:
+                per_w_psnrs = [[] for _ in range(out.shape[1])]
+            for wi, vals in enumerate(obj_w_psnrs):
+                per_w_psnrs[wi].extend(vals)
+            obj_psnrs = obj_w_psnrs[args.w_index]
+            obj_ssims = np.asarray(ssim(gen, gt)).tolist()
+            # copy-view-0 baseline: the score of ignoring the pose
+            # entirely and repeating the conditioning view — synthesis
+            # must beat this
+            copy0 = np.broadcast_to(views["imgs"][:1], gt.shape)
+            obj_base = np.asarray(psnr(copy0, gt)).tolist()
+            psnrs.extend(obj_psnrs)
+            ssims.extend(obj_ssims)
+            base_psnrs.extend(obj_base)
+            gen_views.append(gen)
+            gt_views.append(gt)
+            per_object.append({
+                "id": str(obj),
+                "views": len(obj_psnrs),
+                "psnr": round(float(np.mean(obj_psnrs)), 3),
+                "psnr_std": round(float(np.std(obj_psnrs)), 3),
+                "psnr_copy_view0": round(float(np.mean(obj_base)), 3),
+                "ssim": round(float(np.mean(obj_ssims)), 4),
+            })
+            if args.save_dir:
+                import os
 
-            from PIL import Image
+                from PIL import Image
 
-            from diff3d_tpu.sampling.runtime import to_uint8
+                from diff3d_tpu.sampling.runtime import to_uint8
 
-            d = os.path.join(args.save_dir, str(obj))
-            os.makedirs(d, exist_ok=True)
-            Image.fromarray(to_uint8(views["imgs"][0])).save(
-                os.path.join(d, "view0_cond.png"))
-            for i in range(gen.shape[0]):
-                Image.fromarray(to_uint8(gt[i])).save(
-                    os.path.join(d, f"view{i + 1}_gt.png"))
-                Image.fromarray(to_uint8(gen[i])).save(
-                    os.path.join(d, f"view{i + 1}_gen.png"))
-        logging.info("object %s: psnr %.2f (copy-view-0 %.2f)", obj,
-                     float(np.mean(psnrs[-gen.shape[0]:])),
-                     float(np.mean(base_psnrs[-gen.shape[0]:])))
+                d = os.path.join(args.save_dir, str(obj))
+                os.makedirs(d, exist_ok=True)
+                Image.fromarray(to_uint8(views["imgs"][0])).save(
+                    os.path.join(d, "view0_cond.png"))
+                for v in range(gen.shape[0]):
+                    Image.fromarray(to_uint8(gt[v])).save(
+                        os.path.join(d, f"view{v + 1}_gt.png"))
+                    Image.fromarray(to_uint8(gen[v])).save(
+                        os.path.join(d, f"view{v + 1}_gen.png"))
+            logging.info("object %s: psnr %.2f (copy-view-0 %.2f)", obj,
+                         per_object[-1]["psnr"],
+                         per_object[-1]["psnr_copy_view0"])
+        i = j
+
+    if not gen_views:
+        raise SystemExit(
+            "no views generated: every object had < 2 usable views "
+            "(check --max_views / the dataset)")
+    if fid_key == "fid_randfeat":
+        logging.warning(
+            "FID below uses the seeded random-projection fallback — "
+            "reported as 'fid_randfeat', NOT comparable to paper FID. "
+            "Pass --feature_weights <local VGG16 state dict> for "
+            "real-feature FID.")
 
     fid = fid_from_stats(gaussian_stats(gt_views, feature_fn),
                          gaussian_stats(gen_views, feature_fn))
+    # Per-object dispersion: the quality claim is "synthesis beats the
+    # copy-view-0 baseline by more than the per-object spread", so the
+    # margin's mean/std across objects is first-class output.
+    margins = [o["psnr"] - o["psnr_copy_view0"] for o in per_object]
+    obj_means = [o["psnr"] for o in per_object]
     record = {
         "checkpoint_step": step,
         "objects": len(gen_views),
         "views": len(psnrs),
         "psnr": round(float(np.mean(psnrs)), 3),
         "psnr_copy_view0_baseline": round(float(np.mean(base_psnrs)), 3),
+        "psnr_obj_mean": round(float(np.mean(obj_means)), 3),
+        "psnr_obj_std": round(float(np.std(obj_means)), 3),
+        "psnr_margin_mean": round(float(np.mean(margins)), 3),
+        "psnr_margin_std": round(float(np.std(margins)), 3),
+        "objects_above_baseline": int(sum(m > 0 for m in margins)),
         "psnr_per_w": [round(float(np.mean(p)), 3) for p in per_w_psnrs],
         "ssim": round(float(np.mean(ssims)), 4),
         fid_key: round(float(fid), 3),
         "w_index": args.w_index,
         "timesteps": cfg.diffusion.timesteps,
+        "per_object": per_object,
     }
     print(json.dumps(record))
     if args.out:

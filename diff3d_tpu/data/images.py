@@ -19,24 +19,34 @@ import logging
 import numpy as np
 
 log = logging.getLogger(__name__)
-_warned_out_of_range = False
+# warn_state for direct quantize_uint8(imgs) calls (public API default):
+# one first-call range check process-wide.
+_default_warn_state: dict = {}
 
 
-def quantize_uint8(imgs: np.ndarray) -> np.ndarray:
+def quantize_uint8(imgs: np.ndarray, warn_state: dict = None) -> np.ndarray:
     """Host-side ``[-1, 1] float`` -> ``[0, 255] uint8`` (round-to-nearest).
 
     Inputs are expected in [-1, 1]; anything outside (a future dataset or
     augmentation with wider range / >8-bit precision) would be silently
-    clipped and quantized, so the first offending batch is logged.  Opt out
-    of uint8 transport per loader with ``InfiniteLoader(images_uint8=
-    False)`` for such data.
+    clipped and quantized.  ``warn_state`` is a per-caller mutable dict
+    (e.g. one per :class:`InfiniteLoader`): the FIRST array it sees is
+    range-checked and an out-of-range source logged, then the flag flips
+    so steady state pays no min/max scan and one loader's bad data never
+    silences another's warning.  Default: a process-wide first-call
+    check.  Opt out of uint8 transport per loader with
+    ``InfiniteLoader(images_uint8=False)`` for wide-range data.
     """
     imgs = np.asarray(imgs)
-    global _warned_out_of_range
-    if not _warned_out_of_range:
+    if warn_state is None:
+        warn_state = _default_warn_state
+    if warn_state is not None and not warn_state.get("checked"):
+        # Benign race under the loader's thread pool: concurrent first
+        # calls may each scan (and at worst double-log) — per-loader
+        # state just bounds it to that loader's first batch.
+        warn_state["checked"] = True
         lo, hi = float(imgs.min()), float(imgs.max())
         if lo < -1.0001 or hi > 1.0001:
-            _warned_out_of_range = True
             log.warning(
                 "quantize_uint8: input range [%.3f, %.3f] exceeds [-1, 1]; "
                 "values will be clipped (pass images_uint8=False to the "

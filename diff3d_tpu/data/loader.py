@@ -65,6 +65,7 @@ class InfiniteLoader:
         self.images_uint8 = images_uint8
         self.sample_mode = sample_mode
         self._step = start_step
+        self._quant_warn: Dict[str, bool] = {}   # see quantize_uint8
         self._perm_cache: Dict[int, np.ndarray] = {}
         self._pool = (ThreadPoolExecutor(num_workers)
                       if num_workers > 0 else None)
@@ -111,7 +112,8 @@ class InfiniteLoader:
                 # traffic; see data/images.py) and the conversion
                 # parallelizes across workers.  The jitted step
                 # dequantizes on device.
-                s = dict(s, imgs=quantize_uint8(s["imgs"]))
+                s = dict(s, imgs=quantize_uint8(s["imgs"],
+                                                self._quant_warn))
             return s
 
         if self._pool is not None:
