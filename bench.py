@@ -25,6 +25,9 @@ The same JSON line also carries (on accelerator platforms):
     sampler's exact config (256 steps x 2-in-1 CFG forwards x 8-weight
     guidance sweep, ``/root/reference/sampling.py:130-158``); the
     reference published no timing, so ``vs_baseline`` is null.
+  * ``sampler128`` — the same sampler protocol at the full-width 128^2
+    config (16384-token attention inside the compiled scan); the
+    reference could not sample at 128^2 at all.
 
 Sub-benches that fail (e.g. tunnel compile-helper limits) degrade to an
 ``error`` note instead of killing the primary metric.
@@ -129,31 +132,34 @@ def _train_bench(configs, n_steps: int, config: str):
     return steps_per_sec * global_batch, global_batch, accum
 
 
-def _sampler_bench():
+def _sampler_bench(config: str = "srn64", n_views: int = 4):
     """Seconds per synthesised view, reference sampler config (256 steps,
-    8-weight guidance sweep, 64^2) — one compiled lax.scan per view."""
+    8-weight guidance sweep, ``/root/reference/sampling.py:130-158``) —
+    one compiled lax.scan per view.  ``srn128`` runs the full-resolution
+    model the reference could never sample (OOM before training,
+    README.md:39)."""
     import jax
     import numpy as np
 
-    from diff3d_tpu.config import srn64_config
+    from diff3d_tpu.config import srn64_config, srn128_config
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.sampling.runtime import Sampler
     from diff3d_tpu.train.trainer import init_params
 
-    cfg = srn64_config()
+    cfg = {"srn64": srn64_config, "srn128": srn128_config}[config]()
     model = XUNet(cfg.model)
     rng = jax.random.PRNGKey(0)
     sampler = Sampler(model, init_params(model, cfg, rng), cfg)
 
     rs = np.random.RandomState(0)
-    n_views = 4
+    s = cfg.model.H
     views = {
         "imgs": rs.randn(n_views, cfg.model.H, cfg.model.W,
                          3).astype(np.float32),
         "R": np.broadcast_to(np.eye(3, dtype=np.float32),
                              (n_views, 3, 3)).copy(),
         "T": rs.randn(n_views, 3).astype(np.float32),
-        "K": np.array([[64 * 1.2, 0, 32], [0, 64 * 1.2, 32], [0, 0, 1]],
+        "K": np.array([[s * 1.2, 0, s / 2], [0, s * 1.2, s / 2], [0, 0, 1]],
                       np.float32),
     }
     # Warmup (compile) at the SAME record-buffer capacity as the timed run;
@@ -220,6 +226,18 @@ def main() -> None:
             }
         except Exception as e:
             payload["sampler"] = {"error": str(e).splitlines()[0][:200]}
+        try:
+            # 2 views = 1 synthesised: the timed quantity is one full
+            # 256-step scan at 16384 tokens/frame, full-width srn128.
+            sec_per_view128 = _sampler_bench("srn128", n_views=2)
+            payload["sampler128"] = {
+                "metric": f"sampler_sec_per_view_srn128_{platform}",
+                "value": round(sec_per_view128, 2),
+                "unit": "s/view",
+                "vs_baseline": None,   # reference cannot run 128^2 at all
+            }
+        except Exception as e:
+            payload["sampler128"] = {"error": str(e).splitlines()[0][:200]}
 
     print(json.dumps(payload))
 

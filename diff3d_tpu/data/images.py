@@ -15,6 +15,7 @@ step (resized pixels land off-grid by < 1/255 — invisible to training).
 from __future__ import annotations
 
 import logging
+import os
 
 import numpy as np
 
@@ -22,6 +23,10 @@ log = logging.getLogger(__name__)
 # warn_state for direct quantize_uint8(imgs) calls (public API default):
 # one first-call range check process-wide.
 _default_warn_state: dict = {}
+# DIFF3D_CHECK_RANGE=always: range-check EVERY batch (full min/max scan)
+# instead of only each loader's first — for debugging data that may go
+# out of range mid-run (e.g. a warmup-scheduled augmentation).
+_CHECK_ALWAYS = os.environ.get("DIFF3D_CHECK_RANGE", "").lower() == "always"
 
 
 def quantize_uint8(imgs: np.ndarray, warn_state: dict = None) -> np.ndarray:
@@ -34,13 +39,15 @@ def quantize_uint8(imgs: np.ndarray, warn_state: dict = None) -> np.ndarray:
     range-checked and an out-of-range source logged, then the flag flips
     so steady state pays no min/max scan and one loader's bad data never
     silences another's warning.  Default: a process-wide first-call
-    check.  Opt out of uint8 transport per loader with
+    check.  Data that only goes out of range later in a run is NOT
+    caught by the first-batch check — set ``DIFF3D_CHECK_RANGE=always``
+    to scan every batch, or opt out of uint8 transport per loader with
     ``InfiniteLoader(images_uint8=False)`` for wide-range data.
     """
     imgs = np.asarray(imgs)
     if warn_state is None:
         warn_state = _default_warn_state
-    if warn_state is not None and not warn_state.get("checked"):
+    if _CHECK_ALWAYS or not warn_state.get("checked"):
         # Benign race under the loader's thread pool: concurrent first
         # calls may each scan (and at worst double-log) — per-loader
         # state just bounds it to that loader's first batch.

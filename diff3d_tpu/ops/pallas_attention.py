@@ -16,14 +16,18 @@ hand-tiled TPU kernel:
     accumulating dK/dV over query tiles and one accumulating dQ over key
     tiles, each recomputing the probabilities from (Q, K, lse).  The
     ``delta = rowsum(dO * O)`` term is computed in-kernel from the dO/O
-    blocks (the padded head dim fits one 128-lane tile, so the row sum is
+    blocks (each block holds the full padded head dim, so the row sum is
     block-local).
 
-Head dim is zero-padded to the 128 lane width and sequence lengths to the
-tile size; padded key columns are masked to -1e30 before the softmax so
-both passes ignore them.  All accumulation is float32 regardless of input
-dtype (bf16 inputs still use the MXU with f32 accumulation via
-``preferred_element_type``).
+Head dim is zero-padded to a multiple of the 128 lane width (D <= 512;
+one lane tile at the srn64 deep levels' D=128, two at srn128's D=256 —
+the q/k/v blocks and the output accumulator are ``D_pad`` lanes wide,
+while the running max / sum and the lse residual stay one lane tile) and
+sequence lengths to the tile size; padded key columns are masked to
+-1e30 before the softmax so both passes ignore them.  Zero-padded head
+columns contribute nothing to QK^T and stay zero through PV.  All
+accumulation is float32 regardless of input dtype (bf16 inputs still use
+the MXU with f32 accumulation via ``preferred_element_type``).
 
 On non-TPU backends the kernels run in Pallas interpret mode (tests); the
 dispatcher in :mod:`diff3d_tpu.ops.attention` only routes here on TPU.
@@ -44,13 +48,20 @@ try:  # pltpu is importable without TPU; used for CompilerParams only
 except ImportError:  # pragma: no cover
     pltpu = None
 
-LANE = 128          # TPU lane width: head dim is padded to this
+LANE = 128          # TPU lane width: head dim is padded to a multiple
+MAX_D = 512         # supported head-dim cap (4 lane tiles in VMEM)
 MIN_SUBLANE = 8     # f32 sublane granularity: seq tiles padded to this
 NEG_INF = -1e30
 
 
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
+
+
+def _d_pad(D: int) -> int:
+    """Head dim padded to full lane tiles (128 -> 128, 256 -> 256,
+    96 -> 128, 160 -> 256)."""
+    return _round_up(D, LANE)
 
 
 def _out_struct(shape, dtype, like) -> jax.ShapeDtypeStruct:
@@ -65,13 +76,14 @@ def _out_struct(shape, dtype, like) -> jax.ShapeDtypeStruct:
 
 
 def supports(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> bool:
-    """Shapes/dtypes this kernel handles: ``[B, L, H, D]`` with D <= LANE."""
+    """Shapes/dtypes this kernel handles: ``[B, L, H, D]`` with
+    D <= MAX_D (512; covers srn128's deep-level D=256)."""
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         return False
     if q.dtype not in (jnp.float32, jnp.bfloat16):
         return False
     D = q.shape[-1]
-    return D <= LANE and k.shape[-1] == D and v.shape[-1] == D
+    return D <= MAX_D and k.shape[-1] == D and v.shape[-1] == D
 
 
 def _block_sizes(Lq: int, Lk: int) -> tuple[int, int, int, int]:
@@ -151,19 +163,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_then_scratch,
 
 def _fwd_call(q, k, v, *, scale: float, Lq: int, Lk: int, interpret: bool,
               save_lse: bool):
-    """q/k/v: ``[N, L_pad, LANE]``.  Returns ``o`` (and ``lse
+    """q/k/v: ``[N, L_pad, D_pad]``.  Returns ``o`` (and ``lse
     [N, Lq_pad, LANE]`` lane-replicated when ``save_lse``)."""
-    N, Lq_pad, _ = q.shape
+    N, Lq_pad, D_pad = q.shape
     Lk_pad = k.shape[1]
     bq, bk, _, _ = _block_sizes(Lq_pad, Lk_pad)
     grid = (N, Lq_pad // bq, Lk_pad // bk)
 
-    qo_spec = pl.BlockSpec((1, bq, LANE), lambda n, qi, ki: (n, qi, 0))
-    kv_spec = pl.BlockSpec((1, bk, LANE), lambda n, qi, ki: (n, ki, 0))
+    qo_spec = pl.BlockSpec((1, bq, D_pad), lambda n, qi, ki: (n, qi, 0))
+    kv_spec = pl.BlockSpec((1, bk, D_pad), lambda n, qi, ki: (n, ki, 0))
+    lse_spec = pl.BlockSpec((1, bq, LANE), lambda n, qi, ki: (n, qi, 0))
     out_specs = [qo_spec]
-    out_shape = [_out_struct((N, Lq_pad, LANE), q.dtype, q)]
+    out_shape = [_out_struct((N, Lq_pad, D_pad), q.dtype, q)]
     if save_lse:
-        out_specs.append(qo_spec)
+        out_specs.append(lse_spec)
         out_shape.append(
             _out_struct((N, Lq_pad, LANE), jnp.float32, q))
 
@@ -176,7 +189,7 @@ def _fwd_call(q, k, v, *, scale: float, Lq: int, Lk: int, interpret: bool,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
-            _vmem((bq, LANE)), _vmem((bq, LANE)), _vmem((bq, LANE)),
+            _vmem((bq, LANE)), _vmem((bq, LANE)), _vmem((bq, D_pad)),
         ],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
@@ -263,37 +276,40 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
 
 def _bwd_call(q, k, v, o, lse, do, glse, *, scale: float, Lq: int, Lk: int,
               interpret: bool):
-    N, Lq_pad, _ = q.shape
+    N, Lq_pad, D_pad = q.shape
     Lk_pad = k.shape[1]
     bq, bk, _, _ = _block_sizes(Lq_pad, Lk_pad)
 
-    q_spec = pl.BlockSpec((1, bq, LANE), lambda n, a, b: (n, b, 0))
-    k_spec = pl.BlockSpec((1, bk, LANE), lambda n, ki, qi: (n, ki, 0))
+    q_spec = pl.BlockSpec((1, bq, D_pad), lambda n, a, b: (n, b, 0))
+    k_spec = pl.BlockSpec((1, bk, D_pad), lambda n, ki, qi: (n, ki, 0))
+    lse_spec = pl.BlockSpec((1, bq, LANE), lambda n, a, b: (n, b, 0))
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, Lk=Lk, block_k=bk),
         grid=(N, Lk_pad // bk, Lq_pad // bq),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, q_spec, q_spec],
+        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, lse_spec,
+                  lse_spec],
         out_specs=[k_spec, k_spec],
         out_shape=[
-            _out_struct((N, Lk_pad, LANE), q.dtype, q),
-            _out_struct((N, Lk_pad, LANE), q.dtype, q),
+            _out_struct((N, Lk_pad, D_pad), q.dtype, q),
+            _out_struct((N, Lk_pad, D_pad), q.dtype, q),
         ],
-        scratch_shapes=[_vmem((bk, LANE)), _vmem((bk, LANE))],
+        scratch_shapes=[_vmem((bk, D_pad)), _vmem((bk, D_pad))],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )
     dk, dv = dkdv(q, k, v, o, do, lse, glse)
 
-    q2_spec = pl.BlockSpec((1, bq, LANE), lambda n, qi, ki: (n, qi, 0))
-    k2_spec = pl.BlockSpec((1, bk, LANE), lambda n, qi, ki: (n, ki, 0))
+    q2_spec = pl.BlockSpec((1, bq, D_pad), lambda n, qi, ki: (n, qi, 0))
+    k2_spec = pl.BlockSpec((1, bk, D_pad), lambda n, qi, ki: (n, ki, 0))
+    lse2_spec = pl.BlockSpec((1, bq, LANE), lambda n, qi, ki: (n, qi, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, Lk=Lk, block_k=bk),
         grid=(N, Lq_pad // bq, Lk_pad // bk),
-        in_specs=[q2_spec, k2_spec, k2_spec, q2_spec, q2_spec, q2_spec,
-                  q2_spec],
+        in_specs=[q2_spec, k2_spec, k2_spec, q2_spec, q2_spec, lse2_spec,
+                  lse2_spec],
         out_specs=q2_spec,
-        out_shape=_out_struct((N, Lq_pad, LANE), q.dtype, q),
-        scratch_shapes=[_vmem((bq, LANE))],
+        out_shape=_out_struct((N, Lq_pad, D_pad), q.dtype, q),
+        scratch_shapes=[_vmem((bq, D_pad))],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(q, k, v, o, do, lse, glse)
@@ -305,10 +321,10 @@ def _bwd_call(q, k, v, o, lse, do, glse, *, scale: float, Lq: int, Lk: int,
 # --------------------------------------------------------------------------
 
 def _pad_qkv(x: jnp.ndarray, L_pad: int) -> jnp.ndarray:
-    """[B, L, H, D] -> [B*H, L_pad, LANE]."""
+    """[B, L, H, D] -> [B*H, L_pad, D_pad] (D_pad = full lane tiles)."""
     B, L, H, D = x.shape
     x = jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)
-    return jnp.pad(x, ((0, 0), (0, L_pad - L), (0, LANE - D)))
+    return jnp.pad(x, ((0, 0), (0, L_pad - L), (0, _d_pad(D) - D)))
 
 
 def _unpad(x: jnp.ndarray, B: int, H: int, L: int, D: int) -> jnp.ndarray:

@@ -21,6 +21,8 @@ SHAPES = [
     (2, 256, 256, 4, 128),   # 16x16 tokens, 512ch/4heads
     (1, 200, 200, 2, 32),    # non-multiple-of-128 seq (padded)
     (1, 96, 160, 2, 64),     # cross attention, Lq != Lk
+    (1, 256, 256, 2, 256),   # srn128 deep level: D spans two lane tiles
+    (1, 64, 64, 2, 160),     # D padded up to two lane tiles (160 -> 256)
 ]
 
 
@@ -42,7 +44,7 @@ def test_forward_matches_xla(shape):
     np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
 
 
-@pytest.mark.parametrize("shape", SHAPES[:2])
+@pytest.mark.parametrize("shape", SHAPES[:2] + SHAPES[4:5])
 def test_backward_matches_xla(shape):
     q, k, v = _qkv(shape)
 
@@ -109,9 +111,12 @@ def test_bf16_forward():
 def test_supports_gating():
     q, k, v = _qkv((1, 64, 64, 2, 64))
     assert supports(q, k, v)
-    # head dim beyond one lane tile is rejected -> dispatcher falls back
-    big = jnp.zeros((1, 64, 2, 256))
-    assert not supports(big, big, big)
+    # multi-lane-tile head dims up to MAX_D=512 are handled (srn128's
+    # deep levels run D=256); beyond that the dispatcher falls back
+    d256 = jnp.zeros((1, 64, 2, 256))
+    assert supports(d256, d256, d256)
+    huge = jnp.zeros((1, 64, 2, 640))
+    assert not supports(huge, huge, huge)
     assert not supports(q.astype(jnp.float16), k, v)
 
 

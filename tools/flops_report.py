@@ -1,18 +1,42 @@
 """Report the compiled train step's FLOPs (XLA cost analysis) and the
 achieved TFLOP/s at the measured step time — how much of the chip the
-headline bench config actually uses.
+bench configs actually use.
+
+    python tools/flops_report.py [--config srn64|srn128] [--ceiling 50]
+
+srn64 runs the headline bench shape (batch 128, accum 2); srn128 the
+north-star paper config shape (batch 16, accum 4 — the per-device
+microbatch that fits one chip's HBM, bench.py).  ``--ceiling`` is the
+sustained TFLOP/s to quote utilisation against (default 50: the bf16
+ceiling measured through this dev tunnel's chip; direct-attached v5e is
+~197 bf16 TFLOP/s peak).
 """
 
 from __future__ import annotations
 
+import argparse
 import dataclasses
 import sys
 import time
 
 sys.path.insert(0, ".")
 
+# (global_batch, accum) per config — the shapes bench.py measures.
+BENCH_SHAPE = {"srn64": (128, 2), "srn128": (16, 4)}
+
 
 def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", choices=["srn64", "srn128"],
+                    default="srn64")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--accum", type=int, default=None)
+    ap.add_argument("--ceiling", type=float, default=50.0,
+                    help="sustained TFLOP/s to quote utilisation against")
+    ap.add_argument("--attn_impl", default=None,
+                    choices=["auto", "pallas", "xla"])
+    args = ap.parse_args()
+
     import jax
 
     try:
@@ -20,18 +44,26 @@ def main() -> None:
     except Exception:  # pragma: no cover
         pass
 
-    from diff3d_tpu.config import srn64_config
+    from diff3d_tpu import config as config_lib
     from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.parallel import make_mesh
     from diff3d_tpu.train import create_train_state, make_train_step
     from diff3d_tpu.train.trainer import init_params
 
-    global_batch, accum = 128, 2
-    cfg = srn64_config()
+    global_batch, accum = BENCH_SHAPE[args.config]
+    if args.batch is not None:
+        global_batch = args.batch
+    if args.accum is not None:
+        accum = args.accum
+    cfg = {"srn64": config_lib.srn64_config,
+           "srn128": config_lib.srn128_config}[args.config]()
+    model_over = {"remat": True}
+    if args.attn_impl:
+        model_over["attn_impl"] = args.attn_impl
     cfg = dataclasses.replace(
         cfg,
-        model=dataclasses.replace(cfg.model, remat=True),
+        model=dataclasses.replace(cfg.model, **model_over),
         train=dataclasses.replace(cfg.train, global_batch=global_batch,
                                   accum_steps=accum))
     env = make_mesh(cfg.mesh)
@@ -65,9 +97,14 @@ def main() -> None:
     compiled = traced.compile()
     ca = compiled.cost_analysis()
     flops = ca.get("flops", float("nan")) if ca else float("nan")
+    tflops = flops / dt / 1e12
+    print(f"config: {args.config}  batch {global_batch} x accum {accum}  "
+          f"attn_impl {cfg.model.attn_impl}")
     print(f"step time: {dt*1e3:.1f} ms  ({global_batch / dt:.1f} examples/s)")
     print(f"XLA cost-analysis flops/step: {flops:.3e}")
-    print(f"achieved: {flops / dt / 1e12:.1f} TFLOP/s")
+    print(f"achieved: {tflops:.1f} TFLOP/s "
+          f"({100 * tflops / args.ceiling:.0f}% of the "
+          f"{args.ceiling:.0f}-TFLOP/s ceiling)")
 
 
 if __name__ == "__main__":
