@@ -77,7 +77,10 @@ def main() -> None:
         {"imgs": raw["imgs"], "R": raw["R"], "T": raw["T"], "K": raw["K"]},
         env.batch())
 
-    step_fn = make_train_step(model, cfg, env, donate=False)
+    # Donated state: the timed loop holds ONE live copy of the train
+    # state (donate=False would double it and OOM the full-width srn128
+    # state on a 16G chip).
+    step_fn = make_train_step(model, cfg, env)
     for _ in range(2):
         state, metrics = step_fn(state, batch, rng)
     float(metrics["loss"])
@@ -90,10 +93,13 @@ def main() -> None:
     dt = (time.perf_counter() - t0) / n
 
     # The mesh-sharded step jits lazily inside a closure; lower the
-    # unsharded variant (same program modulo collectives) for analysis.
+    # unsharded variant (same program modulo collectives) for analysis,
+    # on ABSTRACT args (ShapeDtypeStructs — a device_get of the full
+    # state would drag GBs over the dev tunnel).
     fn = make_train_step(model, cfg, env=None, donate=False)
-    # env=None variant jits directly; lower on abstract args.
-    traced = fn.lower(jax.device_get(state), jax.device_get(batch), rng)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), (state, batch))
+    traced = fn.lower(abstract[0], abstract[1], rng)
     compiled = traced.compile()
     ca = compiled.cost_analysis()
     flops = ca.get("flops", float("nan")) if ca else float("nan")
