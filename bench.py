@@ -149,7 +149,12 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4):
     cfg = {"srn64": srn64_config, "srn128": srn128_config}[config]()
     model = XUNet(cfg.model)
     rng = jax.random.PRNGKey(0)
-    sampler = Sampler(model, init_params(model, cfg, rng), cfg)
+    # srn128 full width: one 256-step scan is a ~2-min device execution,
+    # past the dev tunnel's RPC deadline — chunk it into 4 executions
+    # (bit-identical result, test_sampling pins it; chunks=1 elsewhere).
+    chunks = 4 if config == "srn128" else 1
+    sampler = Sampler(model, init_params(model, cfg, rng), cfg,
+                      scan_chunks=chunks)
 
     rs = np.random.RandomState(0)
     s = cfg.model.H

@@ -70,6 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap views per object (full object if omitted)")
     p.add_argument("--steps", type=int, default=None,
                    help="diffusion steps (reference: 256)")
+    p.add_argument("--scan_chunks", type=int, default=1,
+                   help="split each view's diffusion scan into this many "
+                        "device executions (must divide --steps; "
+                        "bit-identical to 1 — raise where one long "
+                        "execution trips an RPC deadline, e.g. "
+                        "full-width 128^2 over a tunneled chip)")
     p.add_argument("--w_index", type=int, default=1,
                    help="guidance-sweep index scored for PSNR/SSIM/FID")
     p.add_argument("--feature_weights", default=None,
@@ -163,7 +169,8 @@ def main(argv=None) -> None:
                         imgsize=cfg.model.H,
                         split_seed=cfg.data.split_seed,
                         train_fraction=cfg.data.train_fraction)
-    sampler = Sampler(model, params, cfg)
+    sampler = Sampler(model, params, cfg,
+                      scan_chunks=args.scan_chunks)
 
     if args.object_batch is None:
         # The batched model call (N*2B examples) and the [N, capacity, B,

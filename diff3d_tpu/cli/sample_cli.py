@@ -32,6 +32,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=None,
                    help="diffusion steps (reference: 256)")
     p.add_argument("--max_views", type=int, default=None)
+    p.add_argument("--scan_chunks", type=int, default=1,
+                   help="split each view's diffusion scan into this many "
+                        "device executions (must divide --steps; "
+                        "bit-identical to 1 — raise where one long "
+                        "execution trips an RPC deadline, e.g. "
+                        "full-width 128^2 over a tunneled chip)")
     p.add_argument("--raw_params", action="store_true",
                    help="sample with raw params instead of EMA")
     p.add_argument("--seed", type=int, default=0)
@@ -80,7 +86,8 @@ def main(argv=None) -> None:
     # Load every view of the target object dir (reference sampling.py:26-48).
     views = load_object_views(os.path.normpath(args.target), cfg.model.H)
 
-    sampler = Sampler(model, params, cfg)
+    sampler = Sampler(model, params, cfg,
+                      scan_chunks=args.scan_chunks)
     sampler.synthesize(views, jax.random.PRNGKey(args.seed),
                        out_dir=args.out, max_views=args.max_views)
     logging.info("wrote %s", args.out)

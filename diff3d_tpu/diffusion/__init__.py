@@ -6,6 +6,8 @@ from diff3d_tpu.diffusion.core import (
     p_mean_variance,
     q_sample,
     sample_loop,
+    sample_loop_prepare,
+    sample_loop_scan,
 )
 
 __all__ = [
@@ -16,4 +18,6 @@ __all__ = [
     "p_mean_variance",
     "q_sample",
     "sample_loop",
+    "sample_loop_prepare",
+    "sample_loop_scan",
 ]

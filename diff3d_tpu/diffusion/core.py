@@ -169,21 +169,53 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
     Returns:
       ``[B, H, W, 3]`` generated view.
     """
-    B = w.shape[0]
-    H, W_ = record_imgs.shape[-3], record_imgs.shape[-2]
+    state, xs = sample_loop_prepare(
+        record_len=record_len, rng=rng, timesteps=timesteps,
+        shape=(w.shape[0],) + record_imgs.shape[-3:],
+        logsnr_min=logsnr_min, logsnr_max=logsnr_max)
+    state = sample_loop_scan(
+        denoise_fn, state, xs, record_imgs=record_imgs, record_R=record_R,
+        record_T=record_T, target_R=target_R, target_T=target_T, K=K,
+        w=w, logsnr_max=logsnr_max, clip_x0=clip_x0)
+    return state.img
 
+
+def sample_loop_prepare(*, record_len: jnp.ndarray, rng: jax.Array,
+                        timesteps: int, shape, logsnr_min: float,
+                        logsnr_max: float):
+    """Initial carry + per-step scan inputs for :func:`sample_loop_scan`.
+
+    Splitting preparation from the scan lets a caller CHUNK the reverse
+    diffusion across several device executions (``Sampler(scan_chunks=k)``)
+    with a bit-identical RNG stream: ``scan(step, s0, xs)`` equals folding
+    ``sample_loop_scan`` over consecutive slices of ``xs`` because every
+    per-step key derives from the carried rng.  (Needed where a single
+    ~2-minute device execution trips an RPC deadline — e.g. the full-width
+    128^2 sampler over this dev tunnel; direct-attached chips keep
+    chunks=1.)  ``shape`` is ``(B, H, W, 3)``.
+    """
     ts = jnp.linspace(1.0, 0.0, timesteps + 1)
     logsnrs = logsnr_schedule_cosine(ts[:-1], logsnr_min=logsnr_min,
                                      logsnr_max=logsnr_max)
     logsnr_nexts = logsnr_schedule_cosine(ts[1:], logsnr_min=logsnr_min,
                                           logsnr_max=logsnr_max)
-
     rng, k_init, k_idx = jax.random.split(rng, 3)
-    init_img = jax.random.normal(k_init, (B, H, W_, 3))
+    init_img = jax.random.normal(k_init, shape)
     # Pre-sampled stochastic-conditioning indices (reference
     # `random.choice(record)`, sampling.py:138) — computed up front so the
     # scan body is trace-static.
     cond_idx = jax.random.randint(k_idx, (timesteps,), 0, record_len)
+    return SampleState(init_img, rng), (logsnrs, logsnr_nexts, cond_idx)
+
+
+def sample_loop_scan(denoise_fn: DenoiseFn, state: SampleState, xs, *,
+                     record_imgs: jnp.ndarray, record_R: jnp.ndarray,
+                     record_T: jnp.ndarray, target_R: jnp.ndarray,
+                     target_T: jnp.ndarray, K: jnp.ndarray, w: jnp.ndarray,
+                     logsnr_max: float, clip_x0: bool) -> SampleState:
+    """``lax.scan`` the ancestral steps in ``xs`` from ``state`` (a full
+    run, or one chunk of it — see :func:`sample_loop_prepare`)."""
+    B = w.shape[0]
 
     Kb = jnp.broadcast_to(K[None], (B, 3, 3))
     w_mask_2b = jnp.concatenate(
@@ -224,6 +256,5 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
                         mean + jnp.sqrt(var) * noise)
         return SampleState(img, rng), None
 
-    state, _ = jax.lax.scan(step, SampleState(init_img, rng),
-                            (logsnrs, logsnr_nexts, cond_idx))
-    return state.img
+    state, _ = jax.lax.scan(step, state, xs)
+    return state

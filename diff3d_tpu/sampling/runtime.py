@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from diff3d_tpu.config import Config
-from diff3d_tpu.diffusion import sample_loop
+from diff3d_tpu.diffusion import (sample_loop, sample_loop_prepare,
+                                  sample_loop_scan)
 from diff3d_tpu.models import XUNet
 
 
@@ -53,15 +54,27 @@ class Sampler:
       model: the X-UNet.
       params: trained parameters (typically the EMA pytree).
       cfg: full config (diffusion.timesteps, guidance_weights, ...).
+      scan_chunks: split each view's reverse-diffusion scan into this many
+        consecutive device executions (bit-identical result — the RNG
+        stream is carried; `test_sampling` pins it).  Keep 1 on
+        direct-attached hardware; raise it where a single multi-minute
+        execution trips an RPC deadline (the full-width 128^2 sampler
+        over the dev tunnel needs ~4).
     """
 
-    def __init__(self, model: XUNet, params, cfg: Config):
+    def __init__(self, model: XUNet, params, cfg: Config,
+                 scan_chunks: int = 1):
         self.model = model
         self.params = params
         self.cfg = cfg
         self.w = jnp.asarray(cfg.diffusion.guidance_weights, jnp.float32)
 
         d = cfg.diffusion
+        if scan_chunks < 1 or d.timesteps % scan_chunks:
+            raise ValueError(
+                f"scan_chunks={scan_chunks} must divide "
+                f"timesteps={d.timesteps}")
+        self.scan_chunks = scan_chunks
 
         # params is a jit ARGUMENT, not a closure constant: closing over
         # it would bake the full weight set into the compiled program
@@ -80,17 +93,78 @@ class Sampler:
                 rng=rng, timesteps=d.timesteps, logsnr_min=d.logsnr_min,
                 logsnr_max=d.logsnr_max, clip_x0=d.clip_x0)
 
-        self._jitted = jax.jit(run)
-        self._run = lambda *args: self._jitted(self.params, *args)
+        # Chunked pieces: `prepare` + `chunk` compose to exactly `run`
+        # (scan over xs == fold of scans over xs slices), but each chunk
+        # is its own device execution.
+        def prepare(record_len, rng, record_imgs):
+            return sample_loop_prepare(
+                record_len=record_len, rng=rng, timesteps=d.timesteps,
+                shape=(self.w.shape[0],) + record_imgs.shape[-3:],
+                logsnr_min=d.logsnr_min, logsnr_max=d.logsnr_max)
+
+        def chunk(params, state, xs, record_imgs, record_R, record_T,
+                  target_R, target_T, K):
+            def denoise(batch, cond_mask):
+                return model.apply({"params": params}, batch,
+                                   cond_mask=cond_mask)
+
+            return sample_loop_scan(
+                denoise, state, xs, record_imgs=record_imgs,
+                record_R=record_R, record_T=record_T, target_R=target_R,
+                target_T=target_T, K=K, w=self.w,
+                logsnr_max=d.logsnr_max, clip_x0=d.clip_x0)
+
+        if scan_chunks == 1:
+            self._jitted = jax.jit(run)
+            self._run = lambda *args: self._jitted(self.params, *args)
+        else:
+            jit_prepare = jax.jit(prepare)
+            jit_chunk = jax.jit(chunk)
+            n_per = d.timesteps // scan_chunks
+
+            def run_chunked(record_imgs, record_R, record_T, record_len,
+                            target_R, target_T, K, rng):
+                state, xs = jit_prepare(record_len, rng, record_imgs)
+                for c in range(scan_chunks):
+                    sl = jax.tree.map(
+                        lambda x: x[c * n_per:(c + 1) * n_per], xs)
+                    state = jit_chunk(self.params, state, sl, record_imgs,
+                                      record_R, record_T, target_R,
+                                      target_T, K)
+                return state.img
+
+            self._run = run_chunked
         # Object-batched variant: vmap folds an extra leading object axis
         # into every model call (N*2B examples instead of 2B), so N
         # independent objects' guidance sweeps share one compiled scan —
         # at 64^2 the per-object batch of 8 underfills the chip and the
         # per-object loop was the eval cost center.  record_len (= view
         # step, shared across objects) stays unbatched.
-        self._jitted_many = jax.jit(jax.vmap(
-            run, in_axes=(None, 0, 0, 0, None, 0, 0, 0, 0)))
-        self._run_many = lambda *args: self._jitted_many(self.params, *args)
+        if scan_chunks == 1:
+            self._jitted_many = jax.jit(jax.vmap(
+                run, in_axes=(None, 0, 0, 0, None, 0, 0, 0, 0)))
+            self._run_many = lambda *args: self._jitted_many(self.params,
+                                                             *args)
+        else:
+            jit_prepare_many = jax.jit(jax.vmap(prepare,
+                                                in_axes=(None, 0, 0)))
+            jit_chunk_many = jax.jit(jax.vmap(
+                chunk, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0)))
+            n_per_many = d.timesteps // scan_chunks
+
+            def run_many_chunked(record_imgs, record_R, record_T,
+                                 record_len, target_R, target_T, K, rngs):
+                state, xs = jit_prepare_many(record_len, rngs, record_imgs)
+                for c in range(scan_chunks):
+                    sl = jax.tree.map(
+                        lambda x: x[:, c * n_per_many:(c + 1) * n_per_many],
+                        xs)
+                    state = jit_chunk_many(
+                        self.params, state, sl, record_imgs, record_R,
+                        record_T, target_R, target_T, K)
+                return state.img
+
+            self._run_many = run_many_chunked
 
     def synthesize(self, views: Dict[str, np.ndarray], rng: jax.Array,
                    out_dir: Optional[str] = None,

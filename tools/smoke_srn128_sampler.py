@@ -23,6 +23,10 @@ def main() -> None:
                         "ch64/emb512/nrb2 quality-run width)")
     p.add_argument("--views", type=int, default=3)
     p.add_argument("--timesteps", type=int, default=256)
+    p.add_argument("--scan_chunks", type=int, default=4,
+                   help="device executions per view scan (must divide "
+                        "timesteps; bit-identical to 1 — keeps each "
+                        "execution under the dev tunnel's RPC deadline)")
     args = p.parse_args()
 
     import dataclasses
@@ -53,7 +57,8 @@ def main() -> None:
     ds = SyntheticScenesDataset(num_objects=1, num_views=args.views + 1,
                                 imgsize=cfg.model.H, seed=0)
     views = ds.all_views(0)
-    sampler = Sampler(model, params, cfg)
+    sampler = Sampler(model, params, cfg,
+                      scan_chunks=args.scan_chunks)
 
     # The record buffer is sized to the next power of two of max_views, so
     # a DIFFERENT max_views can mean a fresh jit signature.  Warm up at
