@@ -34,3 +34,32 @@ def apply_model_width_overrides(cfg, args):
         return cfg
     return dataclasses.replace(
         cfg, model=dataclasses.replace(cfg.model, **over))
+
+
+def load_eval_params(model_dir: str, state, raw_params: bool):
+    """Load ``(step, params)`` for inference from a checkpoint directory of
+    either save mode (full TrainState or ema_bf16 — see
+    ``train/checkpoint.py``).  ``state`` is a template TrainState (shapes/
+    dtypes); ``raw_params`` picks the non-EMA weights, which only full
+    checkpoints carry."""
+    import jax
+
+    from diff3d_tpu.train import CheckpointManager
+
+    mgr = CheckpointManager(model_dir)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+    if mgr.mode == "ema_bf16":
+        if raw_params:
+            raise SystemExit(
+                f"{model_dir} is an ema_bf16 checkpoint: it has no raw "
+                "params to score (--raw_params unavailable)")
+        got = mgr.restore_ema(abstract.params)
+        if got is None:
+            raise FileNotFoundError(f"no checkpoint under {model_dir}")
+        return got
+    restored = mgr.restore(abstract)
+    if restored is None:
+        raise FileNotFoundError(f"no checkpoint under {model_dir}")
+    params = restored.params if raw_params else restored.ema_params
+    return int(restored.step), params

@@ -17,7 +17,8 @@ import logging
 import os
 
 from diff3d_tpu.cli._common import (add_model_width_args,
-                                    apply_model_width_overrides)
+                                    apply_model_width_overrides,
+                                    load_eval_params)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -58,7 +59,7 @@ def main(argv=None) -> None:
     from diff3d_tpu.data.srn import load_object_views
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.sampling import Sampler
-    from diff3d_tpu.train import CheckpointManager, create_train_state
+    from diff3d_tpu.train import create_train_state
     from diff3d_tpu.train.trainer import init_params
 
     cfg = {"srn64": config_lib.srn64_config,
@@ -73,15 +74,8 @@ def main(argv=None) -> None:
     model = XUNet(cfg.model)
     state = create_train_state(
         init_params(model, cfg, jax.random.PRNGKey(0)), cfg.train)
-    mgr = CheckpointManager(args.model)
-    abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
-    restored = mgr.restore(abstract)
-    if restored is None:
-        raise FileNotFoundError(f"no checkpoint under {args.model}")
-    params = restored.params if args.raw_params else restored.ema_params
-    logging.info("loaded step-%d checkpoint from %s",
-                 int(restored.step), args.model)
+    step, params = load_eval_params(args.model, state, args.raw_params)
+    logging.info("loaded step-%d checkpoint from %s", step, args.model)
 
     # Load every view of the target object dir (reference sampling.py:26-48).
     views = load_object_views(os.path.normpath(args.target), cfg.model.H)

@@ -14,7 +14,7 @@ Adam betas (0.9, 0.99), EMA half-life 500K examples.
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import Optional, Sequence
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,6 +116,12 @@ class TrainConfig:
     seed: int = 0
     checkpoint_dir: str = "checkpoints"
     keep_checkpoints: int = 3
+    # "full" = whole TrainState (exact resume); "ema_bf16" = bf16 EMA
+    # params only, ~1/16 the bytes — for checkpointing full-width models
+    # over constrained device->host links (see train/checkpoint.py).
+    # None follows an existing directory marker (resume keeps whatever
+    # mode the run started with), defaulting to "full" on fresh dirs.
+    ckpt_mode: Optional[str] = None
     grad_clip: float = 0.0            # 0 disables (reference has none)
 
 

@@ -23,10 +23,15 @@ log = logging.getLogger(__name__)
 # warn_state for direct quantize_uint8(imgs) calls (public API default):
 # one first-call range check process-wide.
 _default_warn_state: dict = {}
-# DIFF3D_CHECK_RANGE=always: range-check EVERY batch (full min/max scan)
-# instead of only each loader's first — for debugging data that may go
-# out of range mid-run (e.g. a warmup-scheduled augmentation).
-_CHECK_ALWAYS = os.environ.get("DIFF3D_CHECK_RANGE", "").lower() == "always"
+
+
+def _check_always() -> bool:
+    """DIFF3D_CHECK_RANGE=always: range-check EVERY batch (full min/max
+    scan) instead of only each loader's first — for debugging data that
+    may go out of range mid-run (e.g. a warmup-scheduled augmentation).
+    Read per call (os.environ lookup is ~100ns against a min/max scan of
+    a multi-MB batch) so flipping the env var mid-process takes effect."""
+    return os.environ.get("DIFF3D_CHECK_RANGE", "").lower() == "always"
 
 
 def quantize_uint8(imgs: np.ndarray, warn_state: dict = None) -> np.ndarray:
@@ -47,13 +52,16 @@ def quantize_uint8(imgs: np.ndarray, warn_state: dict = None) -> np.ndarray:
     imgs = np.asarray(imgs)
     if warn_state is None:
         warn_state = _default_warn_state
-    if _CHECK_ALWAYS or not warn_state.get("checked"):
+    if _check_always() or not warn_state.get("checked"):
         # Benign race under the loader's thread pool: concurrent first
         # calls may each scan (and at worst double-log) — per-loader
         # state just bounds it to that loader's first batch.
         warn_state["checked"] = True
         lo, hi = float(imgs.min()), float(imgs.max())
-        if lo < -1.0001 or hi > 1.0001:
+        if (lo < -1.0001 or hi > 1.0001) and not warn_state.get("warned"):
+            # Warn once per warn_state even in always-mode: the per-batch
+            # scan is the debugging feature, a warning per batch is spam.
+            warn_state["warned"] = True
             log.warning(
                 "quantize_uint8: input range [%.3f, %.3f] exceeds [-1, 1]; "
                 "values will be clipped (pass images_uint8=False to the "

@@ -131,8 +131,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_then_scratch,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32)                       # [bq, LANE]
-    k = k_ref[0].astype(jnp.float32)                       # [bk, LANE]
+    q = q_ref[0].astype(jnp.float32)                       # [bq, D_pad]
+    k = k_ref[0].astype(jnp.float32)                       # [bk, D_pad]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     s = jnp.where(_key_mask(ki, block_k, Lk), s, NEG_INF)  # [bq, bk]
@@ -144,7 +144,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_then_scratch,
     p = jnp.exp(s - m_new)                                 # [bq, bk]
 
     l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-    v = v_ref[0].astype(jnp.float32)                       # [bk, LANE]
+    v = v_ref[0].astype(jnp.float32)                       # [bk, D_pad]
     pv = jnp.dot(p, v, preferred_element_type=jnp.float32)
     acc_scr[...] = acc_scr[...] * alpha + pv
 
@@ -212,13 +212,14 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0].astype(jnp.float32)                       # [bq, LANE]
-    k = k_ref[0].astype(jnp.float32)                       # [bk, LANE]
+    q = q_ref[0].astype(jnp.float32)                       # [bq, D_pad]
+    k = k_ref[0].astype(jnp.float32)                       # [bk, D_pad]
     v = v_ref[0].astype(jnp.float32)
-    o = o_ref[0].astype(jnp.float32)                       # [bq, LANE]
-    do = do_ref[0].astype(jnp.float32)                     # [bq, LANE]
+    o = o_ref[0].astype(jnp.float32)                       # [bq, D_pad]
+    do = do_ref[0].astype(jnp.float32)                     # [bq, D_pad]
     lse = lse_ref[0][:, :1]                                # [bq, 1]
-    # delta = rowsum(dO * O): block-local (LANE covers the whole head dim)
+    # delta = rowsum(dO * O): block-local (the D_pad-wide block covers the
+    # whole padded head dim; padded columns are zero and contribute 0)
     delta = jnp.sum(do * o, axis=-1, keepdims=True)        # [bq, 1]
     glse = glse_ref[0][:, :1]                              # [bq, 1]
 
@@ -328,7 +329,7 @@ def _pad_qkv(x: jnp.ndarray, L_pad: int) -> jnp.ndarray:
 
 
 def _unpad(x: jnp.ndarray, B: int, H: int, L: int, D: int) -> jnp.ndarray:
-    """[B*H, L_pad, LANE] -> [B, L, H, D]."""
+    """[B*H, L_pad, D_pad] -> [B, L, H, D]."""
     x = x[:, :L, :D].reshape(B, H, L, D)
     return jnp.moveaxis(x, 1, 2)
 

@@ -7,23 +7,68 @@ resumes model + optimizer + step exactly, writes gated on the primary
 process.  TPU-native upgrades: async array writes, step-indexed directories
 with retention, sharded-array-aware restore (each host reads only its
 shards back).
+
+Save modes:
+  * ``"full"`` (default) — the whole TrainState; exact resume.
+  * ``"ema_bf16"`` — ``{step, ema_params}`` with params cast to bfloat16:
+    ~1/16 the bytes of the full state (no Adam moments, no raw params,
+    half-width floats).  Built for constrained device->host links (this
+    image's dev tunnel moves ~1.6 MB/s; a full-width srn64 TrainState is
+    ~1.9 GB = impractical, its bf16 EMA is ~240 MB = minutes).  Restoring
+    gives eval-grade weights and a *warm restart* (optimizer moments are
+    re-zeroed), not an exact resume.
+
+The directory carries a ``ckpt_format.json`` marker so readers
+(``eval_cli``, ``Trainer(transfer=True)``) auto-detect the mode; an
+unmarked directory is ``"full"`` (all checkpoints written before the
+marker existed were full).
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from diff3d_tpu.parallel.multihost import is_primary
 from diff3d_tpu.train.state import TrainState
+
+_MARKER = "ckpt_format.json"
+MODES = ("full", "ema_bf16")
 
 
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3,
-                 save_interval_steps: int | None = None):
+                 save_interval_steps: int | None = None,
+                 mode: str | None = None):
+        """``mode=None`` (readers, resume-without-flag) follows the
+        directory's ``ckpt_format.json`` marker, defaulting to "full" on
+        an unmarked directory.  An explicit mode must AGREE with an
+        existing marker — silently overriding in either direction would
+        either mislabel full checkpoints or quietly discard the user's
+        exact-resume request."""
+        if mode is not None and mode not in MODES:
+            raise ValueError(f"mode={mode!r} not in {MODES}")
         self._dir = os.path.abspath(directory)
+        marker = os.path.join(self._dir, _MARKER)
+        if os.path.exists(marker):
+            with open(marker) as f:
+                marked = json.load(f)["mode"]
+            if marked not in MODES:
+                raise ValueError(
+                    f"{marker} declares unknown mode {marked!r}")
+            if mode is not None and mode != marked:
+                raise ValueError(
+                    f"{self._dir} is marked mode={marked!r} but "
+                    f"mode={mode!r} was requested — use a fresh "
+                    "checkpoint directory to change modes")
+            self.mode = marked
+        else:
+            self.mode = mode or "full"
         options = ocp.CheckpointManagerOptions(
             max_to_keep=keep,
             save_interval_steps=save_interval_steps or 1,
@@ -31,10 +76,32 @@ class CheckpointManager:
             enable_async_checkpointing=True,
         )
         self._mgr = ocp.CheckpointManager(self._dir, options=options)
+        if not os.path.exists(marker) and self.mode != "full":
+            # Never mislabel existing data: an unmarked directory that
+            # already holds checkpoints holds FULL TrainStates (every
+            # writer of non-full data writes the marker first), and
+            # stamping it ema_bf16 would wedge restores of those steps.
+            if self._mgr.latest_step() is not None:
+                raise ValueError(
+                    f"{self._dir} already contains full checkpoints; "
+                    f"refusing to relabel the directory mode={self.mode!r} "
+                    "— use a fresh checkpoint directory")
+            if is_primary():
+                os.makedirs(self._dir, exist_ok=True)
+                with open(marker, "w") as f:
+                    json.dump({"mode": self.mode}, f)
 
     def save(self, state: TrainState, *, force: bool = False) -> bool:
         step = int(jax.device_get(state.step))
-        return self._mgr.save(step, args=ocp.args.StandardSave(state),
+        if self.mode == "ema_bf16":
+            payload = {
+                "step": state.step,
+                "ema_params": jax.tree.map(
+                    lambda x: x.astype(jnp.bfloat16), state.ema_params),
+            }
+        else:
+            payload = state
+        return self._mgr.save(step, args=ocp.args.StandardSave(payload),
                               force=force)
 
     def latest_step(self) -> Optional[int]:
@@ -45,12 +112,53 @@ class CheckpointManager:
         """Restore into the shardings/dtypes of ``abstract_state`` (build it
         with ``jax.eval_shape`` + the mesh's sharding rules).  Returns None
         when no checkpoint exists (fresh run, like the reference's
-        ``--transfer`` being absent)."""
+        ``--transfer`` being absent).
+
+        Only valid for ``mode="full"`` directories — an ``ema_bf16``
+        directory has no optimizer state to restore; use
+        :meth:`restore_ema` (raises ValueError otherwise, rather than
+        silently handing back a half-initialized state).
+        """
+        if self.mode != "full":
+            raise ValueError(
+                f"restore() on a mode={self.mode!r} checkpoint dir; use "
+                "restore_ema() and rebuild the optimizer state")
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract_state))
+
+    def restore_ema(self, abstract_params,
+                    step: int | None = None) -> Optional[Tuple[int, object]]:
+        """Restore ``(step, ema_params)`` from an ``ema_bf16`` directory.
+
+        ``abstract_params`` is the params pytree of ShapeDtypeStructs (its
+        dtypes are the *target* dtypes — bf16-stored arrays are upcast on
+        the way in).  Raises ValueError on a ``full`` directory: restoring
+        only the EMA leaf there would need the whole abstract TrainState
+        anyway, so callers branch on :attr:`mode` (see
+        ``cli/_common.py:load_eval_params`` for the mode-agnostic wrapper).
+        """
+        if self.mode == "full":
+            raise ValueError(
+                "restore_ema() from a full checkpoint needs the whole "
+                "abstract TrainState; call restore() and read .ema_params")
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        abstract_bf16 = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16,
+                                           sharding=s.sharding),
+            abstract_params)
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(
+                {"step": jax.ShapeDtypeStruct((), jnp.int32),
+                 "ema_params": abstract_bf16}))
+        ema = jax.tree.map(
+            lambda x, s: x.astype(s.dtype), restored["ema_params"],
+            abstract_params)
+        return int(restored["step"]), ema
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -83,12 +83,33 @@ class Trainer:
 
         self.ckpt = CheckpointManager(
             os.path.join(workdir, cfg.train.checkpoint_dir),
-            keep=cfg.train.keep_checkpoints)
+            keep=cfg.train.keep_checkpoints,
+            mode=cfg.train.ckpt_mode)
         if transfer:
-            restored = self.ckpt.restore(self._abstract_state())
-            if restored is not None:
-                self.state = restored
-                log.info("resumed at step %d", int(self.state.step))
+            if self.ckpt.mode == "ema_bf16":
+                # Warm restart: EMA-only checkpoints carry no optimizer
+                # moments, so params and EMA both start from the restored
+                # EMA and Adam re-accumulates; the lr schedule is advanced
+                # to the restored step so warmup does not re-run.
+                abstract = self._abstract_state()
+                got = self.ckpt.restore_ema(abstract.params)
+                if got is not None:
+                    step, ema = got
+                    from diff3d_tpu.train.state import advance_schedule
+                    ema = jax.device_put(
+                        ema, self._state_shardings(self.state).params)
+                    self.state = self.state.replace(
+                        step=jnp.asarray(step, jnp.int32),
+                        params=ema,
+                        ema_params=ema,
+                        opt_state=advance_schedule(self.state.opt_state,
+                                                   step))
+                    log.info("warm-restarted (ema_bf16) at step %d", step)
+            else:
+                restored = self.ckpt.restore(self._abstract_state())
+                if restored is not None:
+                    self.state = restored
+                    log.info("resumed at step %d", int(self.state.step))
 
         self.step_fn = make_train_step(self.model, cfg, self.env)
         self._metrics_path = os.path.join(workdir, "metrics.jsonl")

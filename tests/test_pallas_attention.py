@@ -44,7 +44,7 @@ def test_forward_matches_xla(shape):
     np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
 
 
-@pytest.mark.parametrize("shape", SHAPES[:2] + SHAPES[4:5])
+@pytest.mark.parametrize("shape", SHAPES[:2] + SHAPES[4:6])
 def test_backward_matches_xla(shape):
     q, k, v = _qkv(shape)
 

@@ -166,6 +166,73 @@ def test_checkpoint_roundtrip(tmp_path):
     mgr.close()
 
 
+def test_checkpoint_ema_bf16_mode(tmp_path):
+    """ema_bf16 saves ~1/16 the bytes (bf16 EMA only), restores via
+    restore_ema from a marker-detected directory, and the trainer
+    warm-restarts from it (params == ema == restored EMA, step kept)."""
+    cfg = tiny_cfg()
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(init_params(model, cfg, rng), cfg.train)
+    step_fn = make_train_step(model, cfg, env=None, donate=False)
+    state, _ = step_fn(state, make_batch(cfg), rng)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2, mode="ema_bf16")
+    assert mgr.save(state, force=True)
+    mgr.wait()
+    mgr.close()
+
+    # A fresh manager with no mode argument detects ema_bf16 via marker.
+    mgr2 = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr2.mode == "ema_bf16"
+    with pytest.raises(ValueError):
+        mgr2.restore(jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state))
+    abstract_params = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state.params)
+    step, ema = mgr2.restore_ema(abstract_params)
+    assert step == 1
+    for a, b in zip(jax.tree.leaves(state.ema_params),
+                    jax.tree.leaves(ema)):
+        assert np.asarray(b).dtype == np.asarray(a).dtype  # upcast back
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=0.008, rtol=0.008)  # bf16
+    mgr2.close()
+
+    # An unmarked directory that already holds FULL checkpoints must not
+    # be relabelable as ema_bf16 (that would wedge restores of the
+    # existing steps behind a wrong marker).
+    full = CheckpointManager(str(tmp_path / "full"))
+    assert full.save(state, force=True)
+    full.wait()
+    full.close()
+    with pytest.raises(ValueError, match="refusing to relabel"):
+        CheckpointManager(str(tmp_path / "full"), mode="ema_bf16")
+
+
+def test_trainer_warm_restart_from_ema_bf16(tmp_path):
+    cfg = tiny_cfg(max_steps=2, ckpt_every=2, log_every=1,
+                   ckpt_mode="ema_bf16")
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=cfg.model.H)
+    loader = InfiniteLoader(ds, cfg.train.global_batch, seed=0,
+                            num_workers=0)
+    tr = Trainer(cfg, loader, workdir=str(tmp_path))
+    state = tr.train()
+    ema = jax.device_get(state.ema_params)
+
+    loader2 = InfiniteLoader(ds, cfg.train.global_batch, seed=0,
+                             num_workers=0, start_step=2)
+    tr2 = Trainer(cfg, loader2, workdir=str(tmp_path), transfer=True)
+    assert int(tr2.state.step) == 2
+    for a, b in zip(jax.tree.leaves(ema),
+                    jax.tree.leaves(jax.device_get(tr2.state.params))):
+        np.testing.assert_allclose(a, b, atol=0.008, rtol=0.008)
+    # warm restart: params seeded from EMA
+    for a, b in zip(jax.tree.leaves(jax.device_get(tr2.state.params)),
+                    jax.tree.leaves(jax.device_get(tr2.state.ema_params))):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_trainer_end_to_end(tmp_path):
     cfg = tiny_cfg(max_steps=3, ckpt_every=3, log_every=1)
     ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=cfg.model.H)
