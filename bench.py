@@ -198,7 +198,6 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     sampler = Sampler(model, init_params(model, cfg, rng), cfg,
                       scan_chunks=chunks)
 
-    rs = np.random.RandomState(0)
     s = cfg.model.H
 
     def _views(seed):

@@ -101,7 +101,10 @@ class Trainer:
                     self.state = self.state.replace(
                         step=jnp.asarray(step, jnp.int32),
                         params=ema,
-                        ema_params=ema,
+                        # DISTINCT buffers: the train step donates the
+                        # state, and donating the same buffer via two
+                        # leaves fails at execute time.
+                        ema_params=jax.tree.map(jnp.copy, ema),
                         opt_state=advance_schedule(self.state.opt_state,
                                                    step))
                     log.info("warm-restarted (ema_bf16) at step %d", step)

@@ -222,7 +222,9 @@ def test_trainer_warm_restart_from_ema_bf16(tmp_path):
 
     loader2 = InfiniteLoader(ds, cfg.train.global_batch, seed=0,
                              num_workers=0, start_step=2)
-    tr2 = Trainer(cfg, loader2, workdir=str(tmp_path), transfer=True)
+    cfg2 = tiny_cfg(max_steps=3, ckpt_every=10, log_every=1,
+                    ckpt_mode="ema_bf16")
+    tr2 = Trainer(cfg2, loader2, workdir=str(tmp_path), transfer=True)
     assert int(tr2.state.step) == 2
     for a, b in zip(jax.tree.leaves(ema),
                     jax.tree.leaves(jax.device_get(tr2.state.params))):
@@ -231,6 +233,11 @@ def test_trainer_warm_restart_from_ema_bf16(tmp_path):
     for a, b in zip(jax.tree.leaves(jax.device_get(tr2.state.params)),
                     jax.tree.leaves(jax.device_get(tr2.state.ema_params))):
         np.testing.assert_array_equal(a, b)
+    # ... and training actually CONTINUES: the restored params and ema
+    # must be distinct buffers (the step donates the state; aliased
+    # leaves fail at execute time), which only running a step proves.
+    state2 = tr2.train()
+    assert int(state2.step) == 3
 
 
 def test_trainer_end_to_end(tmp_path):

@@ -2,14 +2,17 @@
 achieved TFLOP/s at the measured step time — how much of the chip the
 bench configs actually use.
 
-    python tools/flops_report.py [--config srn64|srn128] [--ceiling 50]
+    python tools/flops_report.py [--config srn64|srn128] [--ceiling 136.6]
 
 srn64 runs the headline bench shape (batch 128, accum 2); srn128 the
 north-star paper config shape (batch 16, accum 4 — the per-device
 microbatch that fits one chip's HBM, bench.py).  ``--ceiling`` is the
-sustained TFLOP/s to quote utilisation against (default 50: the bf16
-ceiling measured through this dev tunnel's chip; direct-attached v5e is
-~197 bf16 TFLOP/s peak).
+sustained TFLOP/s to quote utilisation against (default 136.6: the bf16
+8192³-matmul ceiling MEASURED on this chip by ``tools/roofline.py``,
+committed as ``runs/roofline_r4.json``; v5e datasheet peak is ~197).
+NOTE the model's own conv shapes cap near 35-38 TFLOP/s on this chip
+(roofline.py conv sweep), so a step at ~38 is at its op-mix ceiling even
+though it is far from the matmul ceiling — see docs/DESIGN.md §2.
 """
 
 from __future__ import annotations
@@ -31,7 +34,7 @@ def main() -> None:
                     default="srn64")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--accum", type=int, default=None)
-    ap.add_argument("--ceiling", type=float, default=50.0,
+    ap.add_argument("--ceiling", type=float, default=136.6,
                     help="sustained TFLOP/s to quote utilisation against")
     ap.add_argument("--attn_impl", default=None,
                     choices=["auto", "pallas", "xla"])

@@ -1,0 +1,188 @@
+"""Measure the attached chip's ACHIEVABLE compute ceiling: bf16 (and f32)
+matmul sweep plus one conv shape, value-fetch-synced, median-of-windows.
+
+    python tools/roofline.py [--out runs/roofline.json]
+
+Why this exists (VERDICT r3): DESIGN.md normalized train-step utilisation
+against an assumed "~50 TFLOP/s effective ceiling through the dev tunnel"
+that no committed measurement produced.  This tool produces that number:
+the best sustained TFLOP/s any shape reaches here IS the measured ceiling,
+to be quoted next to the v5e datasheet peak (~197 bf16 TFLOP/s) so MFU
+claims are anchored to evidence at both ends.
+
+Method: for each (M, N, K) a jitted chain of ``steps`` dependent matmuls
+(each output feeds the next via a cheap elementwise touch, defeating CSE
+while keeping the chain's FLOPs = steps * 2MNK) is timed over >=3 windows;
+per-shape TFLOP/s = median window.  The dependent chain means device-side
+back-to-back execution — host/tunnel latency amortises across the chain
+exactly as it does across a train step's layers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _time_windows(fn, sync, windows: int = 3):
+    """Call ``fn()`` (device work) ``windows`` times, value-syncing via
+    ``sync(result)``; returns per-window seconds."""
+    times = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        sync(fn())
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def _matmul_chain(M, N, K, dtype, steps):
+    import jax
+    import jax.numpy as jnp
+
+    def chain(a, b):
+        def body(c, _):
+            c = jax.lax.dot(c, b, precision=None,
+                            preferred_element_type=dtype)
+            # keep magnitudes bounded without leaving the VPU; the
+            # multiply fuses into the matmul epilogue
+            return c * jnp.asarray(1e-3, dtype), None
+
+        c, _ = jax.lax.scan(body, a, None, length=steps)
+        return c
+
+    return jax.jit(chain)
+
+
+def measure_matmul(M, N, K, dtype_name: str, steps: int = 64):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[dtype_name]
+    rs = np.random.RandomState(0)
+    a = jnp.asarray(rs.randn(M, K) * 0.1, dtype)
+    b = jnp.asarray(rs.randn(K, N) * 0.1, dtype)
+    fn = _matmul_chain(M, N, K, dtype, steps)
+    sync = lambda c: float(jnp.sum(c.astype(jnp.float32)))
+    sync(fn(a, b))                                  # compile + warm
+    times = _time_windows(lambda: fn(a, b), sync)
+    flops = 2.0 * M * N * K * steps
+    per_window = sorted(flops / t / 1e12 for t in times)
+    return {
+        "shape": [M, N, K], "dtype": dtype_name, "chain_steps": steps,
+        "tflops_median": round(per_window[len(per_window) // 2], 2),
+        "tflops_windows": [round(v, 2) for v in per_window],
+    }
+
+
+def measure_conv(B, H, W, Cin, Cout, k, dtype_name: str, steps: int = 32):
+    """One NHWC conv shape (the X-UNet stem/block shape class)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[dtype_name]
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(B, H, W, Cin) * 0.1, dtype)
+    w = jnp.asarray(rs.randn(k, k, Cin, Cout) * 0.1, dtype)
+
+    if Cin != Cout:
+        raise ValueError("chain needs Cin == Cout")
+
+    def chain(x, w):
+        def body(c, _):
+            c = jax.lax.conv_general_dilated(
+                c, w, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=dtype)
+            return c * jnp.asarray(1e-2, dtype), None
+
+        c, _ = jax.lax.scan(body, x, None, length=steps)
+        return c
+
+    fn = jax.jit(chain)
+    sync = lambda c: float(jnp.sum(c.astype(jnp.float32)))
+    sync(fn(x, w))
+    times = _time_windows(lambda: fn(x, w), sync)
+    flops = 2.0 * B * H * W * k * k * Cin * Cout * steps
+    per_window = sorted(flops / t / 1e12 for t in times)
+    return {
+        "conv": [B, H, W, Cin, Cout, k], "dtype": dtype_name,
+        "chain_steps": steps,
+        "tflops_median": round(per_window[len(per_window) // 2], 2),
+        "tflops_windows": [round(v, 2) for v in per_window],
+    }
+
+
+# MXU-saturating square shapes + one tall batch-like shape.  (Chained
+# timing needs output shape == input shape, so K == N throughout.)
+MATMUL_SHAPES = [
+    (1024, 1024, 1024),
+    (2048, 2048, 2048),
+    (4096, 4096, 4096),
+    (8192, 8192, 8192),
+    (16384, 4096, 4096),
+]
+# X-UNet conv shape classes (B = microbatch * 2 frames folded together,
+# as the model runs them).  The first two are the srn64 bench step's
+# level-0/level-1 shapes at its microbatch of 64; measured (committed
+# runs/roofline_r4.json): 34.9 and 37.9 TFLOP/s against 136.6 for big
+# matmuls, while the wide 256ch/64^2/B=128 shape reaches 85 — so the
+# model's own levels cap near 35-38 and a train step at ~38 TFLOP/s is
+# at its op-mix ceiling, far though that is from the matmul roofline.
+CONV_SHAPES = [
+    (128, 64, 64, 128, 128, 3),    # srn64 level 0 (ch=128) @ microbatch 64
+    (128, 32, 32, 256, 256, 3),    # srn64 level 1
+    (128, 64, 64, 256, 256, 3),    # srn128-class wide shallow conv
+    (32, 64, 64, 256, 256, 3),     # same at small batch (latency-bound)
+]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None, help="write JSON here too")
+    ap.add_argument("--dtypes", default="bf16,f32")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    result = {
+        "device": str(dev), "platform": dev.platform,
+        "datasheet_peak_bf16_tflops": 197.0,  # v5e (public spec)
+        "matmul": [], "conv": [],
+    }
+    for dtype in args.dtypes.split(","):
+        for M, N, K in MATMUL_SHAPES:
+            try:
+                r = measure_matmul(M, N, K, dtype)
+            except Exception as e:  # OOM on the biggest shapes is fine
+                r = {"shape": [M, N, K], "dtype": dtype,
+                     "error": str(e).splitlines()[0][:120]}
+            result["matmul"].append(r)
+            print(json.dumps(r), file=sys.stderr)
+        for conv_shape in CONV_SHAPES:
+            try:
+                r = measure_conv(*conv_shape, dtype)
+            except Exception as e:
+                r = {"conv": list(conv_shape), "dtype": dtype,
+                     "error": str(e).splitlines()[0][:120]}
+            result["conv"].append(r)
+            print(json.dumps(r), file=sys.stderr)
+
+    best = max((r["tflops_median"] for r in result["matmul"]
+                if "tflops_median" in r and r["dtype"] == "bf16"),
+               default=None)
+    result["measured_ceiling_bf16_tflops"] = best
+    if best:
+        result["ceiling_vs_datasheet"] = round(best / 197.0, 3)
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
