@@ -29,6 +29,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default="srn64")
     p.add_argument("--step", type=int, default=None,
                    help="override the step recorded in the checkpoint")
+    p.add_argument("--verify", action="store_true",
+                   help="verify-only dry run: reconstruct the expected "
+                        "reference key set from --config, report every "
+                        "missing/extra/shape-mismatched key, and exit "
+                        "without writing (non-zero on mismatch).  The "
+                        "same verification always runs before a real "
+                        "conversion.")
     return p
 
 
@@ -41,7 +48,6 @@ def main(argv=None) -> None:
     import jax.numpy as jnp
 
     from diff3d_tpu import config as config_lib
-    from diff3d_tpu.convert import load_torch_checkpoint
     from diff3d_tpu.train import CheckpointManager, create_train_state
     from diff3d_tpu.train.state import advance_schedule
 
@@ -49,7 +55,36 @@ def main(argv=None) -> None:
            "srn128": config_lib.srn128_config,
            "test": config_lib.test_config}[args.config]()
 
-    params, ckpt_step = load_torch_checkpoint(args.torch_ckpt, cfg.model)
+    # Verify the INPUT key set first (torch keys + shapes reconstructed
+    # from config): the real published .pt deserves a complete report of
+    # what is wrong, not a KeyError mid-conversion.
+    import torch
+
+    from diff3d_tpu.convert import convert_state_dict, verify_state_dict
+
+    raw = torch.load(args.torch_ckpt, map_location="cpu",
+                     weights_only=True)
+    if isinstance(raw, dict) and "model" in raw:
+        sd, ckpt_step = raw["model"], int(raw.get("step", 0))
+    else:
+        sd, ckpt_step = raw, 0
+    report = verify_state_dict(sd, cfg.model)
+    n_bad = sum(map(len, report.values()))
+    if n_bad:
+        for kind, items in report.items():
+            for it in items:
+                logging.error("verify: %s: %s", kind, it)
+        raise SystemExit(
+            f"{args.torch_ckpt} does not match --config {args.config}: "
+            f"{len(report['missing'])} missing, {len(report['extra'])} "
+            f"extra, {len(report['shape_mismatch'])} shape-mismatched "
+            "keys (full list above)")
+    logging.info("verify: %s matches the expected %s key set "
+                 "(%d tensors)", args.torch_ckpt, args.config, len(sd))
+    if args.verify:
+        return
+
+    params = convert_state_dict(sd, cfg.model)
     step = args.step if args.step is not None else ckpt_step
 
     params = jax.tree.map(jnp.asarray, params)

@@ -1,4 +1,7 @@
 from diff3d_tpu.convert.torch_ckpt import (convert_state_dict,
-                                           load_torch_checkpoint)
+                                           expected_torch_state,
+                                           load_torch_checkpoint,
+                                           verify_state_dict)
 
-__all__ = ["convert_state_dict", "load_torch_checkpoint"]
+__all__ = ["convert_state_dict", "expected_torch_state",
+           "load_torch_checkpoint", "verify_state_dict"]

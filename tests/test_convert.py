@@ -271,3 +271,85 @@ def test_convert_cli_rejects_config_mismatch(tmp_path, cfg_and_params):
                               "--config", "test"])
     finally:
         config_lib.test_config = orig
+
+
+def test_expected_torch_state_matches_torch_oracle():
+    """expected_torch_state's reconstructed key set must equal the REAL
+    state_dict of the torch-composed reference model (tests/_torch_xunet),
+    keys and shapes both — so convert_cli --verify is checking published
+    checkpoints against the same scheme the parity oracle implements."""
+    torch = pytest.importorskip("torch")
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from _torch_xunet import TXUNet
+
+    from diff3d_tpu.config import test_config
+    from diff3d_tpu.convert import expected_torch_state
+
+    cfg = test_config(imgsize=16, ch=8).model
+    sd = {k: tuple(v.shape) for k, v in TXUNet(cfg).state_dict().items()}
+    want = expected_torch_state(cfg)
+    assert sd.keys() == want.keys(), (
+        sorted(sd.keys() - want.keys()), sorted(want.keys() - sd.keys()))
+    bad = {k: (sd[k], want[k]) for k in sd if sd[k] != want[k]}
+    assert not bad, bad
+
+
+def test_verify_state_dict_reports_corruption(cfg_and_params):
+    """A deliberately-corrupted checkpoint yields a complete report:
+    every missing, extra, and shape-mismatched key is named."""
+    from diff3d_tpu.convert import verify_state_dict
+
+    cfg, params = cfg_and_params
+    sd = _invert(jax.tree.map(np.asarray, params), cfg)
+
+    clean = verify_state_dict(sd, cfg)
+    assert clean == {"missing": [], "extra": [], "shape_mismatch": []}
+    # module. prefix (DataParallel) is stripped before comparison
+    assert verify_state_dict(
+        {f"module.{k}": v for k, v in sd.items()}, cfg) == clean
+
+    bad = dict(sd)
+    del bad["lastconv.bias"]                              # missing
+    bad["totally.bogus.weight"] = np.zeros((3, 3))        # extra
+    bad["conv.weight"] = bad["conv.weight"][..., :1]      # shape mismatch
+    report = verify_state_dict(bad, cfg)
+    assert report["missing"] == ["lastconv.bias"]
+    assert report["extra"] == ["totally.bogus.weight"]
+    assert [k for k, *_ in report["shape_mismatch"]] == ["conv.weight"]
+
+
+def test_convert_cli_verify_dry_run(tmp_path, cfg_and_params):
+    """--verify on a corrupted .pt exits non-zero with the report and
+    writes nothing; on a clean .pt it exits cleanly and writes nothing."""
+    torch = pytest.importorskip("torch")
+    cfg, params = cfg_and_params
+    sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+          for k, v in _invert(jax.tree.map(np.asarray, params), cfg).items()}
+
+    import dataclasses
+
+    from diff3d_tpu import config as config_lib
+    from diff3d_tpu.cli import convert_cli
+
+    patched = dataclasses.replace(config_lib.test_config(), model=cfg)
+    orig = config_lib.test_config
+    config_lib.test_config = lambda *a, **k: patched
+    try:
+        pt = tmp_path / "clean.pt"
+        torch.save({"model": sd, "step": 1}, pt)
+        out = tmp_path / "ckpt"
+        convert_cli.main(["--torch_ckpt", str(pt), "--out", str(out),
+                          "--config", "test", "--verify"])
+        assert not out.exists()
+
+        bad = dict(sd)
+        del bad["lastconv.bias"]
+        pt2 = tmp_path / "bad.pt"
+        torch.save({"model": bad, "step": 1}, pt2)
+        with pytest.raises(SystemExit, match="1 missing"):
+            convert_cli.main(["--torch_ckpt", str(pt2), "--out", str(out),
+                              "--config", "test", "--verify"])
+        assert not out.exists()
+    finally:
+        config_lib.test_config = orig

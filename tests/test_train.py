@@ -143,6 +143,57 @@ def test_replicated_and_sharded_steps_agree():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(param_sharding="fsdp"),
+    MeshConfig(model_parallel=2, param_sharding="fsdp+tp"),
+    MeshConfig(model_parallel=2, context_parallel=True),
+], ids=["fsdp", "fsdp+tp", "context-parallel"])
+def test_multi_step_trajectory_equality(mesh_cfg):
+    """25-step TRAJECTORY equality: the sharded step must track the
+    single-device step through a long chain of Adam/EMA updates and
+    step-folded rng draws, not just agree on one update (r3 VERDICT:
+    1-2-step equality can hide slow divergence from e.g. a sharding-
+    dependent reduction order or a mis-folded per-step rng)."""
+    import dataclasses
+
+    n_steps = 25
+    cfg = tiny_cfg()
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    params = init_params(model, cfg, rng)
+    # A 3-batch cycle gives data variation across steps without paying
+    # loader overhead 25 times.
+    batches = [make_batch(cfg, seed=s) for s in range(3)]
+
+    def run(env, cfg_run):
+        s = create_train_state(params, cfg_run.train)
+        if env is not None:
+            s = jax.device_put(s, env.state_shardings(s))
+        f = make_train_step(model, cfg_run, env, donate=False)
+        losses = []
+        for i in range(n_steps):
+            b = batches[i % len(batches)]
+            if env is not None:
+                b = jax.device_put(b, env.batch())
+            s, m = f(s, b, rng)
+            losses.append(float(m["loss"]))
+        return (np.asarray(losses), jax.device_get(s.params),
+                jax.device_get(s.ema_params))
+
+    ref_losses, ref_params, ref_ema = run(None, cfg)
+    cfg_sharded = dataclasses.replace(cfg, mesh=mesh_cfg)
+    env = make_mesh(mesh_cfg)
+    losses, params_s, ema_s = run(env, cfg_sharded)
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(params_s)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-3)
+    for a, b in zip(jax.tree.leaves(ref_ema), jax.tree.leaves(ema_s)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-3)
+
+
 def test_checkpoint_roundtrip(tmp_path):
     cfg = tiny_cfg()
     model = XUNet(cfg.model)
