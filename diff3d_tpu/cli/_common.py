@@ -24,24 +24,47 @@ def add_model_width_args(p: argparse.ArgumentParser) -> None:
                    help="conditioning embedding width (reference: 1024)")
     p.add_argument("--num_res_blocks", type=int, default=None,
                    help="res blocks per UNet level (reference: 3)")
+    p.add_argument("--imgsize", type=int, default=None,
+                   help="square image resolution H=W — overrides the "
+                        "--config preset (must match the trained "
+                        "checkpoint; must be divisible by 2^(levels-1))")
 
 
 def apply_model_width_overrides(cfg, args):
-    """Returns ``cfg`` with any of --ch/--emb_ch/--num_res_blocks applied."""
+    """Returns ``cfg`` with any of --ch/--emb_ch/--num_res_blocks applied,
+    plus --imgsize (H=W resolution override)."""
     over = {k: getattr(args, k) for k in _WIDTH_KEYS
             if getattr(args, k) is not None}
+    if getattr(args, "imgsize", None) is not None:
+        over["H"] = over["W"] = args.imgsize
     if not over:
         return cfg
     return dataclasses.replace(
         cfg, model=dataclasses.replace(cfg.model, **over))
 
 
+def build_abstract_state(cfg):
+    """Abstract TrainState template (ShapeDtypeStructs, nothing
+    materialised) for ``XUNet(cfg.model)`` — the restore target every
+    checkpoint-consuming CLI needs.  ``jax.eval_shape`` means no params,
+    moments, or EMA are ever allocated just to describe the tree."""
+    import jax
+
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.train import create_train_state
+    from diff3d_tpu.train.trainer import init_params
+
+    model = XUNet(cfg.model)
+    return jax.eval_shape(lambda: create_train_state(
+        init_params(model, cfg, jax.random.PRNGKey(0)), cfg.train))
+
+
 def load_eval_params(model_dir: str, state, raw_params: bool):
     """Load ``(step, params)`` for inference from a checkpoint directory of
     either save mode (full TrainState or ema_bf16 — see
-    ``train/checkpoint.py``).  ``state`` is a template TrainState (shapes/
-    dtypes); ``raw_params`` picks the non-EMA weights, which only full
-    checkpoints carry."""
+    ``train/checkpoint.py``).  ``state`` is a template TrainState —
+    abstract (:func:`build_abstract_state`) or concrete; ``raw_params``
+    picks the non-EMA weights, which only full checkpoints carry."""
     import jax
 
     from diff3d_tpu.train import CheckpointManager

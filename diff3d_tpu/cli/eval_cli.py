@@ -31,6 +31,7 @@ import logging
 
 from diff3d_tpu.cli._common import (add_model_width_args,
                                     apply_model_width_overrides,
+                                    build_abstract_state,
                                     load_eval_params)
 
 
@@ -129,8 +130,6 @@ def main(argv=None) -> None:
     from diff3d_tpu.evaluation.features import resolve_feature_fn
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.sampling import Sampler
-    from diff3d_tpu.train import create_train_state
-    from diff3d_tpu.train.trainer import init_params
 
     cfg = {"srn64": config_lib.srn64_config,
            "srn128": config_lib.srn128_config,
@@ -148,9 +147,8 @@ def main(argv=None) -> None:
     feature_fn = jax.jit(feature_fn)
 
     model = XUNet(cfg.model)
-    state = create_train_state(
-        init_params(model, cfg, jax.random.PRNGKey(0)), cfg.train)
-    step, params = load_eval_params(args.model, state, args.raw_params)
+    step, params = load_eval_params(args.model, build_abstract_state(cfg),
+                                    args.raw_params)
 
     if args.synthetic_scenes:
         from diff3d_tpu.data import SyntheticScenesDataset

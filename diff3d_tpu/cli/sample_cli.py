@@ -18,6 +18,7 @@ import os
 
 from diff3d_tpu.cli._common import (add_model_width_args,
                                     apply_model_width_overrides,
+                                    build_abstract_state,
                                     load_eval_params)
 
 
@@ -59,8 +60,6 @@ def main(argv=None) -> None:
     from diff3d_tpu.data.srn import load_object_views
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.sampling import Sampler
-    from diff3d_tpu.train import create_train_state
-    from diff3d_tpu.train.trainer import init_params
 
     cfg = {"srn64": config_lib.srn64_config,
            "srn128": config_lib.srn128_config,
@@ -72,9 +71,8 @@ def main(argv=None) -> None:
     cfg = apply_model_width_overrides(cfg, args)
 
     model = XUNet(cfg.model)
-    state = create_train_state(
-        init_params(model, cfg, jax.random.PRNGKey(0)), cfg.train)
-    step, params = load_eval_params(args.model, state, args.raw_params)
+    step, params = load_eval_params(args.model, build_abstract_state(cfg),
+                                    args.raw_params)
     logging.info("loaded step-%d checkpoint from %s", step, args.model)
 
     # Load every view of the target object dir (reference sampling.py:26-48).

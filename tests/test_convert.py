@@ -8,6 +8,8 @@ state dict with the reference's exact key scheme and shapes (derived from
 rules, then checks that conversion reproduces the Flax init tree exactly —
 structure, shapes, and values."""
 
+import dataclasses
+
 import jax
 import numpy as np
 import pytest
@@ -353,3 +355,51 @@ def test_convert_cli_verify_dry_run(tmp_path, cfg_and_params):
         assert not out.exists()
     finally:
         config_lib.test_config = orig
+
+
+def test_progressive_resolution_transfer():
+    """64->128-style transfer at toy scale: every param copies except
+    pos_emb (bilinearly upsampled); the adapted tree initializes the
+    higher-resolution model and its forward runs."""
+    import jax.numpy as jnp
+
+    from diff3d_tpu.convert.progressive import (adapt_params_resolution,
+                                                check_resolution_compatible)
+
+    cfg_lo = tiny_cfg()                                   # 16x16
+    hi = dataclasses.replace(cfg_lo, H=32, W=32)
+    params_lo = _randomize(_init_params(cfg_lo), np.random.default_rng(0))
+
+    adapted = adapt_params_resolution(params_lo, (32, 32))
+    params_hi = _init_params(hi)
+    check_resolution_compatible(adapted, params_hi)       # no raise
+
+    pe_lo = params_lo["conditioningprocessor"]["pos_emb"]
+    pe_hi = adapted["conditioningprocessor"]["pos_emb"]
+    assert pe_hi.shape == (32, 32, pe_lo.shape[2])
+    # bilinear: corners track the source corners, mean is preserved-ish
+    np.testing.assert_allclose(np.asarray(pe_hi).mean(),
+                               np.asarray(pe_lo).mean(), atol=0.02)
+    # non-pos_emb leaves are copied verbatim
+    np.testing.assert_array_equal(
+        np.asarray(adapted["stem_conv"]["kernel"]),
+        np.asarray(params_lo["stem_conv"]["kernel"]))
+
+    model = XUNet(hi)
+    B = 1
+    batch = {
+        "x": jnp.zeros((B, 32, 32, 3)), "z": jnp.zeros((B, 32, 32, 3)),
+        "logsnr": jnp.zeros((B, 2)),
+        "R": jnp.broadcast_to(jnp.eye(3), (B, 2, 3, 3)),
+        "t": jnp.zeros((B, 2, 3)),
+        "K": jnp.broadcast_to(jnp.eye(3) * 16.0, (B, 3, 3)),
+    }
+    out = model.apply({"params": adapted}, batch,
+                      cond_mask=jnp.ones((B,), bool))
+    assert out.shape == (B, 32, 32, 3)
+    assert bool(jnp.isfinite(out).all())
+
+    # width mismatch is refused with a named leaf
+    wrong = dataclasses.replace(hi, ch=16)
+    with pytest.raises(ValueError, match="shape mismatch|tree mismatch"):
+        check_resolution_compatible(adapted, _init_params(wrong))
