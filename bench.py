@@ -219,16 +219,76 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
         sampler.synthesize(views, rng, max_views=n_views)
         t0 = time.perf_counter()
         sampler.synthesize(views, rng, max_views=n_views)
-        return (time.perf_counter() - t0) / (n_views - 1)
+        raw = time.perf_counter() - t0
+        return raw / (n_views - 1), raw, n_views - 1
     views_list = [_views(i) for i in range(object_batch)]
     rngs = list(jax.random.split(rng, object_batch))
     sampler.synthesize_many(views_list, rngs, max_views=n_views)
     t0 = time.perf_counter()
     sampler.synthesize_many(views_list, rngs, max_views=n_views)
-    return (time.perf_counter() - t0) / (object_batch * (n_views - 1))
+    raw = time.perf_counter() - t0
+    return raw / (object_batch * (n_views - 1)), raw, (object_batch
+                                                       * (n_views - 1))
 
 
-def main() -> None:
+def _acquire_backend(attempts: int = 6, wait_s: float = 75.0):
+    """``jax.devices()`` with retry.
+
+    Round 4's official capture was voided by a single transient
+    ``UNAVAILABLE`` raised from backend *initialization* — upstream of
+    every downstream robustness layer (median-of-3 windows, compile-helper
+    retry).  The tunneled chip's faults are transient (the same chip did
+    ~30 chip-hours of real work that round), so re-dialing with a backoff
+    is the correct response; only after ``attempts`` consecutive failures
+    is the error allowed to surface (and ``main`` still turns it into a
+    parseable JSON line).
+    """
+    import signal
+
+    import jax
+
+    def _with_timeout(fn, seconds: int = 180):
+        """Run ``fn()`` under SIGALRM: during the r4 outage the dial
+        didn't raise, it HUNG — a retry loop alone would never get its
+        second attempt.  (Best-effort: a hang inside a C++ call that
+        holds the GIL can't be interrupted; the observed hang is in the
+        RPC wait, which can.)"""
+        def _raise(signum, frame):
+            raise TimeoutError(f"backend dial exceeded {seconds}s")
+
+        prev = signal.signal(signal.SIGALRM, _raise)
+        signal.alarm(seconds)
+        try:
+            return fn()
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, prev)
+
+    last = None
+    for attempt in range(attempts):
+        try:
+            return _with_timeout(jax.devices)
+        except Exception as e:  # UNAVAILABLE / DEADLINE_EXCEEDED / hang
+            last = e
+            print(f"bench: backend init attempt {attempt + 1}/{attempts} "
+                  f"failed: {str(e).splitlines()[0][:200]}",
+                  file=sys.stderr)
+            try:
+                # Drop the poisoned client so the next jax.devices()
+                # re-dials the backend instead of returning the cached
+                # failure (private API; jax 0.9 has no public equivalent —
+                # guarded so an API move degrades to plain retry).
+                from jax._src import xla_bridge
+
+                xla_bridge._clear_backends()
+            except Exception:
+                pass
+            if attempt < attempts - 1:
+                time.sleep(wait_s)
+    raise last
+
+
+def main() -> int:
     import jax
 
     try:  # persistent compile cache across driver rounds
@@ -236,8 +296,23 @@ def main() -> None:
     except Exception:  # pragma: no cover
         pass
 
-    platform = jax.devices()[0].platform
-    ndev = len(jax.devices())
+    try:
+        devices = _acquire_backend()
+    except Exception as e:
+        # The record must ALWAYS parse: a bench that dies before printing
+        # leaves the round with no official perf evidence at all (r4).
+        print(json.dumps({
+            "metric": "train_examples_per_sec_srn64",
+            "value": None,
+            "unit": "examples/s",
+            "vs_baseline": None,
+            "error": f"backend init failed after retries: "
+                     f"{str(e).splitlines()[0][:300]}",
+        }))
+        return 0
+
+    platform = devices[0].platform
+    ndev = len(devices)
     on_accel = platform != "cpu"
     # srn64 configs in preference order: the reference's exact global batch
     # 128 (2 accumulation microbatches fit one 16G chip), then direct
@@ -246,8 +321,18 @@ def main() -> None:
     configs = [(128, 2), (64, 1), (32, 1)] if on_accel else [(8, 1)]
     n_steps = 10 if on_accel else 3
 
-    examples_per_sec, global_batch, accum, stats = _train_bench(
-        configs, n_steps, "srn64")
+    try:
+        examples_per_sec, global_batch, accum, stats = _train_bench(
+            configs, n_steps, "srn64")
+    except Exception as e:
+        print(json.dumps({
+            "metric": f"train_examples_per_sec_srn64_{platform}_x{ndev}",
+            "value": None,
+            "unit": "examples/s",
+            "vs_baseline": None,
+            "error": str(e).splitlines()[0][:300],
+        }))
+        return 0
     name = f"b{global_batch}" + (f"x{accum}accum" if accum > 1 else "")
     payload = {
         "metric": f"train_examples_per_sec_srn64_{name}_{platform}"
@@ -277,12 +362,14 @@ def main() -> None:
         except Exception as e:
             payload["srn128"] = {"error": str(e).splitlines()[0][:200]}
         try:
-            sec_per_view = _sampler_bench()
+            sec_per_view, raw_s, n_eff = _sampler_bench()
             payload["sampler"] = {
                 "metric": f"sampler_sec_per_view_srn64_{platform}",
                 "value": round(sec_per_view, 2),
                 "unit": "s/view",
                 "vs_baseline": None,   # reference published no timing
+                "raw_seconds": round(raw_s, 2),
+                "effective_views": n_eff,
             }
         except Exception as e:
             payload["sampler"] = {"error": str(e).splitlines()[0][:200]}
@@ -291,20 +378,26 @@ def main() -> None:
             # per batched 256-step scan at 16384 tokens/frame, full-width
             # srn128 — the configuration eval_cli ships with (the unbatched
             # worst case was r3's 107 s/view; the shipping path amortises
-            # the scan across objects).
-            sec_per_view128 = _sampler_bench("srn128", n_views=2,
-                                             object_batch=2)
+            # the scan across objects).  raw_seconds/effective_views keep
+            # the longitudinal record comparable across metric semantics
+            # (ADVICE r4): raw_seconds is the wall time of ONE batched
+            # scan pass, value = raw_seconds / effective_views.
+            sec_per_view128, raw_s128, n_eff128 = _sampler_bench(
+                "srn128", n_views=2, object_batch=2)
             payload["sampler128"] = {
                 "metric": f"sampler_sec_per_view_srn128_objbatch2_"
                           f"{platform}",
                 "value": round(sec_per_view128, 2),
                 "unit": "s/view",
                 "vs_baseline": None,   # reference cannot run 128^2 at all
+                "raw_seconds": round(raw_s128, 2),
+                "effective_views": n_eff128,
             }
         except Exception as e:
             payload["sampler128"] = {"error": str(e).splitlines()[0][:200]}
 
     print(json.dumps(payload))
+    return 0
 
 
 if __name__ == "__main__":

@@ -74,7 +74,11 @@ def load_eval_params(model_dir: str, state, raw_params: bool):
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
     if mgr.mode == "ema_bf16":
         if raw_params:
-            raise SystemExit(
+            # Typed error at the library layer; the CLIs present argparse
+            # problems as SystemExit themselves (ADVICE r4 — train_cli's
+            # --init_from path also lands here, and a library misuse
+            # should not look like a clean CLI exit).
+            raise ValueError(
                 f"{model_dir} is an ema_bf16 checkpoint: it has no raw "
                 "params to score (--raw_params unavailable)")
         got = mgr.restore_ema(abstract.params)

@@ -16,6 +16,24 @@ scored against ground truth:
     number is reported as ``fid_randfeat`` — the key always says which
     extractor produced the value (``evaluation/features.py``).
 
+Evaluation is OUTAGE-PROOF: synthesis and scoring are separate phases.
+Each object's generated views are written to ``--resume_dir`` (default
+``<out>.objdir``) the moment its batch finishes; re-running the same
+command skips already-synthesised objects and proceeds straight to
+scoring, so a link failure N objects in costs nothing but the partial
+batch.  Scoring always recomputes every metric from the on-disk records,
+so the final JSON is identical whether the run completed in one pass or
+five.
+
+``--w_select K`` adds validation-selected guidance: K EXTRA objects
+(drawn after the eval set — disjoint from it) are synthesised, the
+guidance weight with the best mean PSNR on them is chosen, and the eval
+set is additionally scored at that weight (``*_w_selected`` fields).
+The fixed ``--w_index`` headline is unchanged; selection never sees an
+eval object.  This is the methodologically clean version of the
+reference's w=0..7 sweep (``/root/reference/sampling.py:158``), whose
+point is that the best w is data-dependent.
+
 Writes one JSON line to stdout and (optionally) ``--out`` JSONL.
 
 Usage:
@@ -28,6 +46,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 
 from diff3d_tpu.cli._common import (add_model_width_args,
                                     apply_model_width_overrides,
@@ -80,6 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "full-width 128^2 over a tunneled chip)")
     p.add_argument("--w_index", type=int, default=1,
                    help="guidance-sweep index scored for PSNR/SSIM/FID")
+    p.add_argument("--w_select", type=int, default=0,
+                   help="ALSO score at a validation-selected guidance "
+                        "weight: synthesise this many extra selection "
+                        "objects (disjoint from the eval set, drawn after "
+                        "it), pick the w with the best mean PSNR on them, "
+                        "and report *_w_selected fields at that w")
     p.add_argument("--feature_weights", default=None,
                    help="local VGG16 state-dict file (.pth/.pt/.npz, "
                         "torchvision key names) for real-feature FID; "
@@ -87,11 +112,64 @@ def build_parser() -> argparse.ArgumentParser:
                         "fid_randfeat")
     p.add_argument("--raw_params", action="store_true")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--out", default=None, help="append JSONL here")
+    p.add_argument("--out", default=None, help="append final JSONL here")
+    p.add_argument("--resume_dir", default=None,
+                   help="per-object synthesis records live here (one .npz "
+                        "per object, written as each object completes); "
+                        "re-running skips objects already present.  "
+                        "Default: <--out>.objdir when --out is given, "
+                        "else a fresh temp dir (no resumability)")
     p.add_argument("--save_dir", default=None,
                    help="dump gt/generated view PNGs here "
                         "(<obj>/view{V}_{gt,gen}.png)")
     return p
+
+
+def _record_path(resume_dir: str, obj, step: int) -> str:
+    # checkpoint step is part of the NAME, not the settings stamp: after
+    # more training, the same longitudinal eval command simply finds no
+    # records for the new step and re-synthesises (stale-step records are
+    # ignored, not a fatal protocol conflict) — while a dataset/model/
+    # seed/timesteps mismatch against a same-step record stays a hard
+    # error, since silently mixing those corrupts the aggregate.
+    return os.path.join(resume_dir, f"obj_s{step}_{obj}.npz")
+
+
+def _save_object_record(resume_dir: str, obj, gen, meta: dict) -> None:
+    """Atomically persist one object's generated views (all guidance
+    weights, float16 — ~2.4 MB at 128^2) plus the synthesis settings
+    they were produced under."""
+    import numpy as np
+
+    path = _record_path(resume_dir, obj, meta["checkpoint_step"])
+    tmp = path + ".tmp"
+    np.savez_compressed(tmp, gen=gen.astype(np.float16),
+                        meta=json.dumps(meta))
+    # np.savez appends .npz to names it doesn't recognise
+    if os.path.exists(tmp + ".npz"):
+        tmp += ".npz"
+    os.replace(tmp, path)
+
+
+def _load_object_record(resume_dir: str, obj, expect_meta: dict):
+    """Return (gen float32, True) if a valid record exists, else
+    (None, False).  A record whose synthesis settings don't match the
+    current flags is a hard error — silently mixing protocols would
+    corrupt the aggregate."""
+    import numpy as np
+
+    path = _record_path(resume_dir, obj, expect_meta["checkpoint_step"])
+    if not os.path.exists(path):
+        return None, False
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+        gen = z["gen"]                   # float16, cast per-use
+    if meta != expect_meta:
+        raise SystemExit(
+            f"resume record {path} was synthesised under different "
+            f"settings ({meta} != {expect_meta}); clear --resume_dir or "
+            "point it elsewhere")
+    return gen, True
 
 
 def main(argv=None) -> None:
@@ -108,13 +186,14 @@ def main(argv=None) -> None:
         raise SystemExit("pass --val_data or --synthetic_scenes")
     if (args.synthetic_scenes and args.scenes_seed == 0
             and args.scene_objects is not None
-            and args.objects > args.scene_objects):
+            and args.objects + args.w_select > args.scene_objects):
         raise SystemExit(
             f"--scenes_seed 0 scores training scenes, but --objects "
-            f"{args.objects} exceeds the trained --scene_objects "
-            f"{args.scene_objects}: objects beyond the trained count were "
-            "never seen in training and would be mislabeled as 'train' "
-            "scores — lower --objects or drop --scene_objects")
+            f"{args.objects} + --w_select {args.w_select} exceeds the "
+            f"trained --scene_objects {args.scene_objects}: objects "
+            "beyond the trained count were never seen in training and "
+            "would be mislabeled as 'train' scores — lower --objects or "
+            "drop --scene_objects")
     if args.object_batch is not None and args.object_batch < 1:
         raise SystemExit("--object_batch must be >= 1")
 
@@ -147,13 +226,18 @@ def main(argv=None) -> None:
     feature_fn = jax.jit(feature_fn)
 
     model = XUNet(cfg.model)
-    step, params = load_eval_params(args.model, build_abstract_state(cfg),
-                                    args.raw_params)
+    try:
+        step, params = load_eval_params(args.model,
+                                        build_abstract_state(cfg),
+                                        args.raw_params)
+    except ValueError as e:   # e.g. --raw_params on an ema_bf16 checkpoint
+        raise SystemExit(str(e))
 
+    n_dataset_objs = max(8, args.objects + args.w_select)
     if args.synthetic_scenes:
         from diff3d_tpu.data import SyntheticScenesDataset
 
-        ds = SyntheticScenesDataset(num_objects=max(8, args.objects),
+        ds = SyntheticScenesDataset(num_objects=n_dataset_objs,
                                     imgsize=cfg.model.H,
                                     seed=args.scenes_seed)
     else:
@@ -173,93 +257,204 @@ def main(argv=None) -> None:
         logging.info("object_batch auto -> %d (H=%d)", args.object_batch,
                      cfg.model.H)
 
-    # Per-object keys are split off in object order BEFORE batching, so
-    # the scores are invariant to --object_batch (same key -> same
-    # per-object stream; see Sampler.synthesize_many).
+    ephemeral_resume_dir = None
+    if args.resume_dir is None:
+        if args.out:
+            args.resume_dir = args.out + ".objdir"
+        else:
+            import tempfile
+
+            # no --out, no --resume_dir: records still go through disk
+            # (one scoring path) but the dir is ours to delete at exit —
+            # otherwise every throwaway eval leaks MBs of npz into /tmp
+            args.resume_dir = tempfile.mkdtemp(prefix="diff3d_eval_")
+            ephemeral_resume_dir = args.resume_dir
+    os.makedirs(args.resume_dir, exist_ok=True)
+    if ephemeral_resume_dir is not None:
+        import atexit
+        import shutil
+
+        atexit.register(shutil.rmtree, ephemeral_resume_dir,
+                        ignore_errors=True)
+
+    # Per-object keys are split off in object order BEFORE batching —
+    # eval objects first, then the w_select selection objects — so the
+    # scores are invariant to --object_batch AND to resume boundaries
+    # (same key -> same per-object stream; see Sampler.synthesize_many),
+    # and adding --w_select never perturbs an eval object's stream.
     rng = jax.random.PRNGKey(args.seed)
-    objs = list(ds.ids[: args.objects])
-    obj_views, obj_keys = [], []
-    for obj in objs:
-        obj_views.append(ds.all_views(obj))
+    if len(ds.ids) < args.objects + args.w_select:
+        raise SystemExit(
+            f"dataset has {len(ds.ids)} val objects; --objects "
+            f"{args.objects} + --w_select {args.w_select} requested")
+    eval_objs = list(ds.ids[: args.objects])
+    sel_objs = list(ds.ids[args.objects: args.objects + args.w_select])
+    all_objs = eval_objs + sel_objs
+    obj_views, obj_keys = {}, {}
+    for obj in all_objs:
+        obj_views[obj] = ds.all_views(obj)
         rng, k = jax.random.split(rng)
-        obj_keys.append(k)
+        obj_keys[obj] = k
 
     def n_views_of(v) -> int:
         n = int(v["imgs"].shape[0])
         return min(n, args.max_views) if args.max_views else n
 
-    per_object = []
-    psnrs, base_psnrs, ssims, gen_views, gt_views = [], [], [], [], []
-    per_w_psnrs = None
+    # Synthesis settings stamp: a resume record is valid only if it was
+    # produced by an identical sampling protocol — including the model
+    # directory and the DATASET identity (without it, a seed-0 and a
+    # seed-1 eval sharing an --out would silently score each other's
+    # generations against the wrong ground truth).
+    dataset_id = (f"scenes:{args.scenes_seed}" if args.synthetic_scenes
+                  else f"srn:{os.path.abspath(args.val_data)}")
+    expect_meta = {
+        "model": os.path.abspath(args.model),
+        "dataset": dataset_id,
+        "checkpoint_step": int(step),
+        "timesteps": int(cfg.diffusion.timesteps),
+        "seed": int(args.seed),
+        "max_views": args.max_views,
+        "H": int(cfg.model.H),
+    }
+
+    # ---- Phase 1: synthesis (resumable; each object lands on disk the
+    # moment its batch completes) -------------------------------------
+    gens = {}
+    todo = []
+    for obj in all_objs:
+        gen, ok = _load_object_record(args.resume_dir, obj, expect_meta)
+        if ok:
+            gens[obj] = gen
+        else:
+            todo.append(obj)
+    if gens:
+        logging.info("resume: %d/%d objects already synthesised in %s",
+                     len(gens), len(all_objs), args.resume_dir)
+
+    progress_path = os.path.join(args.resume_dir, "progress.jsonl")
     i = 0
-    while i < len(objs):
+    while i < len(todo):
         # chunk of <= object_batch consecutive objects with equal view
         # counts (synthesize_many truncates to the batch minimum)
-        j, nv = i + 1, n_views_of(obj_views[i])
-        while (j < len(objs) and j - i < args.object_batch
-               and n_views_of(obj_views[j]) == nv):
+        j = i + 1
+        nv = n_views_of(obj_views[todo[i]])
+        while (j < len(todo) and j - i < args.object_batch
+               and n_views_of(obj_views[todo[j]]) == nv):
             j += 1
-        outs = sampler.synthesize_many(obj_views[i:j], obj_keys[i:j],
+        batch = todo[i:j]
+        outs = sampler.synthesize_many([obj_views[o] for o in batch],
+                                       [obj_keys[o] for o in batch],
                                        max_views=args.max_views)
-        for obj, views, out in zip(objs[i:j], obj_views[i:j], outs):
-            if out.shape[0] == 0:
-                continue
-            gen = out[:, args.w_index]                 # [V-1, H, W, 3]
-            gt = views["imgs"][1: 1 + gen.shape[0]]
-            # the guidance sweep is the batch axis — score every w while
-            # the samples are in hand (picking w after the fact is free);
-            # the headline psnr list reuses this object's w_index column
-            obj_w_psnrs = [np.asarray(psnr(out[:, wi], gt)).tolist()
-                           for wi in range(out.shape[1])]
-            if per_w_psnrs is None:
-                per_w_psnrs = [[] for _ in range(out.shape[1])]
-            for wi, vals in enumerate(obj_w_psnrs):
-                per_w_psnrs[wi].extend(vals)
-            obj_psnrs = obj_w_psnrs[args.w_index]
-            obj_ssims = np.asarray(ssim(gen, gt)).tolist()
-            # copy-view-0 baseline: the score of ignoring the pose
-            # entirely and repeating the conditioning view — synthesis
-            # must beat this
-            copy0 = np.broadcast_to(views["imgs"][:1], gt.shape)
-            obj_base = np.asarray(psnr(copy0, gt)).tolist()
+        for obj, out in zip(batch, outs):
+            # float16 in memory AND on disk: a fresh pass and a resumed
+            # pass (which reads the float16 record back) score the SAME
+            # pixels, and the resident full-sweep arrays cost half the
+            # bytes (scoring casts one w column at a time to float32)
+            gens[obj] = np.asarray(out, np.float16)
+            _save_object_record(args.resume_dir, obj, gens[obj],
+                                expect_meta)
+            with open(progress_path, "a") as f:
+                f.write(json.dumps({"object": str(obj),
+                                    "views": int(out.shape[0])}) + "\n")
+            logging.info("synthesised object %s (%d views) -> %s", obj,
+                         out.shape[0],
+                         _record_path(args.resume_dir, obj,
+                                      expect_meta["checkpoint_step"]))
+        i = j
+
+    # ---- Phase 2: scoring (pure recomputation from the records; a
+    # resumed run and a single-pass run produce the same JSON) ---------
+    def score_object(obj):
+        """Per-view PSNR at every w + copy baseline for one object.
+        ``out`` stays float16 ([V-1, B, H, W, 3]); metric passes cast one
+        w column at a time so the resident footprint is halved."""
+        out = gens[obj]
+        if out.shape[0] == 0:
+            return None
+        views = obj_views[obj]
+        gt = views["imgs"][1: 1 + out.shape[0]]
+        w_psnrs = [np.asarray(psnr(out[:, wi].astype(np.float32),
+                                   gt)).tolist()
+                   for wi in range(out.shape[1])]
+        copy0 = np.broadcast_to(views["imgs"][:1], gt.shape)
+        base = np.asarray(psnr(copy0, gt)).tolist()
+        return {"out": out, "gt": gt, "w_psnrs": w_psnrs, "base": base}
+
+    scored = {obj: score_object(obj) for obj in all_objs}
+    eval_scored = [(o, scored[o]) for o in eval_objs if scored[o]]
+    if not eval_scored:
+        raise SystemExit(
+            "no views generated: every object had < 2 usable views "
+            "(check --max_views / the dataset)")
+
+    # Guidance-weight selection on the DISJOINT selection objects: best
+    # pooled mean PSNR across their views.  The copy baseline is
+    # w-independent, so argmax-PSNR == argmax-margin.
+    w_selected = None
+    if args.w_select:
+        sel_scored = [scored[o] for o in sel_objs if scored[o]]
+        if not sel_scored:
+            raise SystemExit("--w_select objects produced no views")
+        n_w = len(sel_scored[0]["w_psnrs"])
+        sel_per_w = [float(np.mean([v for s in sel_scored
+                                    for v in s["w_psnrs"][wi]]))
+                     for wi in range(n_w)]
+        w_selected = int(np.argmax(sel_per_w))
+        logging.info("w_select: per-w PSNR on %d selection objects: %s "
+                     "-> w_selected=%d", len(sel_scored),
+                     [round(v, 3) for v in sel_per_w], w_selected)
+
+    # GT features never vary with w: one stats pass shared by every
+    # aggregate() call (fixed-w headline AND w_selected).
+    gt_stats = gaussian_stats([s["gt"] for _, s in eval_scored],
+                              feature_fn)
+    agg_cache = {}
+
+    def aggregate(w_index):
+        """Headline + per-object stats of the EVAL set at one w (cached:
+        when selection picks the same w as the fixed headline, the
+        second call is free instead of re-running SSIM + FID)."""
+        if w_index in agg_cache:
+            return agg_cache[w_index]
+        per_object, psnrs, base_psnrs, ssims = [], [], [], []
+        gen_views = []
+        for obj, s in eval_scored:
+            obj_psnrs = s["w_psnrs"][w_index]
+            gen = s["out"][:, w_index].astype(np.float32)
+            obj_ssims = np.asarray(ssim(gen, s["gt"])).tolist()
             psnrs.extend(obj_psnrs)
             ssims.extend(obj_ssims)
-            base_psnrs.extend(obj_base)
+            base_psnrs.extend(s["base"])
             gen_views.append(gen)
-            gt_views.append(gt)
             per_object.append({
                 "id": str(obj),
                 "views": len(obj_psnrs),
                 "psnr": round(float(np.mean(obj_psnrs)), 3),
                 "psnr_std": round(float(np.std(obj_psnrs)), 3),
-                "psnr_copy_view0": round(float(np.mean(obj_base)), 3),
+                "psnr_copy_view0": round(float(np.mean(s["base"])), 3),
                 "ssim": round(float(np.mean(obj_ssims)), 4),
             })
-            if args.save_dir:
-                import os
+        fid = fid_from_stats(gt_stats,
+                             gaussian_stats(gen_views, feature_fn))
+        margins = [o["psnr"] - o["psnr_copy_view0"] for o in per_object]
+        obj_means = [o["psnr"] for o in per_object]
+        agg_cache[w_index] = {
+            "objects": len(per_object),
+            "views": len(psnrs),
+            "psnr": round(float(np.mean(psnrs)), 3),
+            "psnr_copy_view0_baseline": round(float(np.mean(base_psnrs)),
+                                              3),
+            "psnr_obj_mean": round(float(np.mean(obj_means)), 3),
+            "psnr_obj_std": round(float(np.std(obj_means)), 3),
+            "psnr_margin_mean": round(float(np.mean(margins)), 3),
+            "psnr_margin_std": round(float(np.std(margins)), 3),
+            "objects_above_baseline": int(sum(m > 0 for m in margins)),
+            "ssim": round(float(np.mean(ssims)), 4),
+            fid_key: round(float(fid), 3),
+            "per_object": per_object,
+        }
+        return agg_cache[w_index]
 
-                from PIL import Image
-
-                from diff3d_tpu.sampling.runtime import to_uint8
-
-                d = os.path.join(args.save_dir, str(obj))
-                os.makedirs(d, exist_ok=True)
-                Image.fromarray(to_uint8(views["imgs"][0])).save(
-                    os.path.join(d, "view0_cond.png"))
-                for v in range(gen.shape[0]):
-                    Image.fromarray(to_uint8(gt[v])).save(
-                        os.path.join(d, f"view{v + 1}_gt.png"))
-                    Image.fromarray(to_uint8(gen[v])).save(
-                        os.path.join(d, f"view{v + 1}_gen.png"))
-            logging.info("object %s: psnr %.2f (copy-view-0 %.2f)", obj,
-                         per_object[-1]["psnr"],
-                         per_object[-1]["psnr_copy_view0"])
-        i = j
-
-    if not gen_views:
-        raise SystemExit(
-            "no views generated: every object had < 2 usable views "
-            "(check --max_views / the dataset)")
     if fid_key == "fid_randfeat":
         logging.warning(
             "FID below uses the seeded random-projection fallback — "
@@ -267,31 +462,44 @@ def main(argv=None) -> None:
             "Pass --feature_weights <local VGG16 state dict> for "
             "real-feature FID.")
 
-    fid = fid_from_stats(gaussian_stats(gt_views, feature_fn),
-                         gaussian_stats(gen_views, feature_fn))
-    # Per-object dispersion: the quality claim is "synthesis beats the
-    # copy-view-0 baseline by more than the per-object spread", so the
-    # margin's mean/std across objects is first-class output.
-    margins = [o["psnr"] - o["psnr_copy_view0"] for o in per_object]
-    obj_means = [o["psnr"] for o in per_object]
-    record = {
-        "checkpoint_step": step,
-        "objects": len(gen_views),
-        "views": len(psnrs),
-        "psnr": round(float(np.mean(psnrs)), 3),
-        "psnr_copy_view0_baseline": round(float(np.mean(base_psnrs)), 3),
-        "psnr_obj_mean": round(float(np.mean(obj_means)), 3),
-        "psnr_obj_std": round(float(np.std(obj_means)), 3),
-        "psnr_margin_mean": round(float(np.mean(margins)), 3),
-        "psnr_margin_std": round(float(np.std(margins)), 3),
-        "objects_above_baseline": int(sum(m > 0 for m in margins)),
-        "psnr_per_w": [round(float(np.mean(p)), 3) for p in per_w_psnrs],
-        "ssim": round(float(np.mean(ssims)), 4),
-        fid_key: round(float(fid), 3),
-        "w_index": args.w_index,
-        "timesteps": cfg.diffusion.timesteps,
-        "per_object": per_object,
-    }
+    # Per-w pooled PSNR over the eval set (the reference's 0..7 sweep
+    # readout) — selection objects are excluded from every eval metric.
+    n_w = len(eval_scored[0][1]["w_psnrs"])
+    per_w_psnrs = [
+        round(float(np.mean([v for _, s in eval_scored
+                             for v in s["w_psnrs"][wi]])), 3)
+        for wi in range(n_w)]
+
+    record = {"checkpoint_step": step, **aggregate(args.w_index),
+              "psnr_per_w": per_w_psnrs, "w_index": args.w_index,
+              "timesteps": cfg.diffusion.timesteps}
+    if w_selected is not None:
+        sel_agg = aggregate(w_selected)
+        record["w_selected"] = w_selected
+        record["w_select_objects"] = [str(o) for o in sel_objs]
+        for key in ("psnr", "psnr_margin_mean", "psnr_margin_std",
+                    "objects_above_baseline", "ssim", fid_key):
+            record[f"{key}_w_selected"] = sel_agg[key]
+        record["per_object_w_selected"] = sel_agg["per_object"]
+
+    if args.save_dir:
+        from PIL import Image
+
+        from diff3d_tpu.sampling.runtime import to_uint8
+
+        for obj, s in eval_scored:
+            gen = s["out"][:, args.w_index]
+            d = os.path.join(args.save_dir, str(obj))
+            os.makedirs(d, exist_ok=True)
+            Image.fromarray(
+                to_uint8(obj_views[obj]["imgs"][0])).save(
+                    os.path.join(d, "view0_cond.png"))
+            for v in range(gen.shape[0]):
+                Image.fromarray(to_uint8(s["gt"][v])).save(
+                    os.path.join(d, f"view{v + 1}_gt.png"))
+                Image.fromarray(to_uint8(gen[v])).save(
+                    os.path.join(d, f"view{v + 1}_gen.png"))
+
     print(json.dumps(record))
     if args.out:
         with open(args.out, "a") as f:

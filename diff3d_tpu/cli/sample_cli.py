@@ -71,8 +71,12 @@ def main(argv=None) -> None:
     cfg = apply_model_width_overrides(cfg, args)
 
     model = XUNet(cfg.model)
-    step, params = load_eval_params(args.model, build_abstract_state(cfg),
-                                    args.raw_params)
+    try:
+        step, params = load_eval_params(args.model,
+                                        build_abstract_state(cfg),
+                                        args.raw_params)
+    except ValueError as e:   # e.g. --raw_params on an ema_bf16 checkpoint
+        raise SystemExit(str(e))
     logging.info("loaded step-%d checkpoint from %s", step, args.model)
 
     # Load every view of the target object dir (reference sampling.py:26-48).

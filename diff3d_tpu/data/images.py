@@ -58,14 +58,22 @@ def quantize_uint8(imgs: np.ndarray, warn_state: dict = None) -> np.ndarray:
         # state just bounds it to that loader's first batch.
         warn_state["checked"] = True
         lo, hi = float(imgs.min()), float(imgs.max())
-        if (lo < -1.0001 or hi > 1.0001) and not warn_state.get("warned"):
-            # Warn once per warn_state even in always-mode: the per-batch
-            # scan is the debugging feature, a warning per batch is spam.
-            warn_state["warned"] = True
-            log.warning(
-                "quantize_uint8: input range [%.3f, %.3f] exceeds [-1, 1]; "
-                "values will be clipped (pass images_uint8=False to the "
-                "loader to keep full precision)", lo, hi)
+        if lo < -1.0001 or hi > 1.0001:
+            # Warn on the first offence, then only when the violation
+            # WORSENS past the previously warned extremes: a steady
+            # out-of-range stream logs once, but data drifting further
+            # out mid-run (always-mode's stated use case) keeps
+            # signalling instead of being latched silent (ADVICE r4).
+            worst_lo = warn_state.get("warned_lo", -1.0)
+            worst_hi = warn_state.get("warned_hi", 1.0)
+            if lo < worst_lo - 1e-6 or hi > worst_hi + 1e-6:
+                warn_state["warned_lo"] = min(lo, worst_lo)
+                warn_state["warned_hi"] = max(hi, worst_hi)
+                log.warning(
+                    "quantize_uint8: input range [%.3f, %.3f] exceeds "
+                    "[-1, 1]; values will be clipped (pass "
+                    "images_uint8=False to the loader to keep full "
+                    "precision)", lo, hi)
     return np.clip((imgs + 1.0) * 127.5 + 0.5, 0, 255).astype(np.uint8)
 
 

@@ -17,6 +17,15 @@ Save modes:
     ~1.9 GB = impractical, its bf16 EMA is ~240 MB = minutes).  Restoring
     gives eval-grade weights and a *warm restart* (optimizer moments are
     re-zeroed), not an exact resume.
+  * ``"full_sliced"`` — the whole TrainState streamed leaf-by-leaf as N
+    sequential small device->host fetches + ``.npy`` writes with
+    per-leaf retry, committed atomically (write to ``<step>.tmp``,
+    rename).  Same EXACT-resume semantics as ``full`` (params, EMA,
+    Adam moments, step), built for links where one monolithic save is a
+    20-minute single point of failure: a transient fault costs one
+    leaf's retry, not the whole save, and no single RPC ever moves more
+    than the largest parameter (a few MB).  Single-host writer (each
+    leaf is fully fetched); pods should keep Orbax ``full``.
 
 The directory carries a ``ckpt_format.json`` marker so readers
 (``eval_cli``, ``Trainer(transfer=True)``) auto-detect the mode; an
@@ -27,18 +36,25 @@ marker existed were full).
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shutil
+import time
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
 
 from diff3d_tpu.parallel.multihost import is_primary
 from diff3d_tpu.train.state import TrainState
 
+log = logging.getLogger(__name__)
+
 _MARKER = "ckpt_format.json"
-MODES = ("full", "ema_bf16")
+_SLICED_MANIFEST = "sliced_manifest.json"
+MODES = ("full", "ema_bf16", "full_sliced")
 
 
 class CheckpointManager:
@@ -69,19 +85,45 @@ class CheckpointManager:
             self.mode = marked
         else:
             self.mode = mode or "full"
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=keep,
-            save_interval_steps=save_interval_steps or 1,
-            create=True,
-            enable_async_checkpointing=True,
-        )
-        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+        self._keep = keep
+        if self.mode == "full_sliced":
+            # No Orbax involvement: saves are plain per-leaf .npy files
+            # under <dir>/<step>/ with an atomic-rename commit.  The
+            # writer fully fetches every leaf, which needs all shards
+            # addressable and exactly one writer — single-host only
+            # (pods keep Orbax 'full', whose per-host shard IO is the
+            # point).
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "ckpt mode 'full_sliced' is single-host only "
+                    f"(process_count={jax.process_count()}); use 'full'")
+            self._mgr = None
+            if is_primary():
+                os.makedirs(self._dir, exist_ok=True)
+        else:
+            options = ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                save_interval_steps=save_interval_steps or 1,
+                create=True,
+                enable_async_checkpointing=True,
+            )
+            self._mgr = ocp.CheckpointManager(self._dir, options=options)
         if not os.path.exists(marker) and self.mode != "full":
             # Never mislabel existing data: an unmarked directory that
             # already holds checkpoints holds FULL TrainStates (every
             # writer of non-full data writes the marker first), and
-            # stamping it ema_bf16 would wedge restores of those steps.
-            if self._mgr.latest_step() is not None:
+            # stamping it ema_bf16/full_sliced would wedge restores of
+            # those steps.
+            existing = (self._sliced_steps() if self._mgr is None
+                        else ([self._mgr.latest_step()]
+                              if self._mgr.latest_step() is not None
+                              else []))
+            has_orbax_dirs = any(
+                d.isdigit() and not os.path.exists(
+                    os.path.join(self._dir, d, _SLICED_MANIFEST))
+                for d in (os.listdir(self._dir)
+                          if os.path.isdir(self._dir) else []))
+            if existing or (self._mgr is None and has_orbax_dirs):
                 raise ValueError(
                     f"{self._dir} already contains full checkpoints; "
                     f"refusing to relabel the directory mode={self.mode!r} "
@@ -91,7 +133,91 @@ class CheckpointManager:
                 with open(marker, "w") as f:
                     json.dump({"mode": self.mode}, f)
 
+    # ---- full_sliced internals -------------------------------------
+
+    def _sliced_steps(self):
+        if not os.path.isdir(self._dir):
+            return []
+        return sorted(
+            int(d) for d in os.listdir(self._dir)
+            if d.isdigit() and os.path.exists(
+                os.path.join(self._dir, d, _SLICED_MANIFEST)))
+
+    def _save_sliced(self, state: TrainState) -> bool:
+        step = int(jax.device_get(state.step))
+        final = os.path.join(self._dir, str(step))
+        if os.path.exists(final):
+            return False
+        tmp = final + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        leaves, _ = jax.tree_util.tree_flatten(state)
+        manifest = {"step": step, "leaves": []}
+        for i, leaf in enumerate(leaves):
+            for attempt in range(3):
+                try:
+                    arr = np.asarray(jax.device_get(leaf))
+                    break
+                except Exception as e:   # transient link fault: one leaf
+                    if attempt == 2:     # retries, not the whole save
+                        raise
+                    log.warning(
+                        "sliced save: leaf %d fetch failed (%s); retrying",
+                        i, str(e).splitlines()[0][:120])
+                    time.sleep(5.0 * (attempt + 1))
+            dtype = str(arr.dtype)       # ml_dtypes name, e.g. 'bfloat16'
+            if dtype == "bfloat16":      # np.save can't round-trip bf16
+                arr = arr.view(np.uint16)
+            np.save(os.path.join(tmp, f"leaf_{i:05d}.npy"), arr)
+            manifest["leaves"].append(
+                {"dtype": dtype, "shape": list(arr.shape)})
+        with open(os.path.join(tmp, _SLICED_MANIFEST), "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, final)           # commit: readers never see partial
+        if self._keep and self._keep > 0:   # keep<=0 means keep-all
+            for old in self._sliced_steps()[: -self._keep]:
+                shutil.rmtree(os.path.join(self._dir, str(old)),
+                              ignore_errors=True)
+        return True
+
+    def _restore_sliced(self, abstract_state: TrainState,
+                        step: int | None) -> Optional[TrainState]:
+        steps = self._sliced_steps()
+        step = step if step is not None else (steps[-1] if steps else None)
+        if step is None:
+            return None
+        d = os.path.join(self._dir, str(step))
+        with open(os.path.join(d, _SLICED_MANIFEST)) as f:
+            manifest = json.load(f)
+        abs_leaves, treedef = jax.tree_util.tree_flatten(abstract_state)
+        if len(abs_leaves) != len(manifest["leaves"]):
+            raise ValueError(
+                f"sliced checkpoint at {d} has {len(manifest['leaves'])} "
+                f"leaves; the target state has {len(abs_leaves)} — "
+                "model/optimizer config mismatch")
+        out = []
+        for i, (sds, meta) in enumerate(zip(abs_leaves,
+                                            manifest["leaves"])):
+            if tuple(meta["shape"]) != tuple(sds.shape):
+                raise ValueError(
+                    f"sliced checkpoint at {d}: leaf {i} has shape "
+                    f"{tuple(meta['shape'])}, target expects "
+                    f"{tuple(sds.shape)} — model/optimizer config "
+                    "mismatch")
+            arr = np.load(os.path.join(d, f"leaf_{i:05d}.npy"))
+            if meta["dtype"] == "bfloat16":
+                arr = jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)
+            arr = jnp.asarray(arr).astype(sds.dtype)
+            sharding = getattr(sds, "sharding", None)
+            out.append(jax.device_put(arr, sharding)
+                       if sharding is not None else arr)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # ---- public API ------------------------------------------------
+
     def save(self, state: TrainState, *, force: bool = False) -> bool:
+        if self.mode == "full_sliced":
+            return self._save_sliced(state)
         step = int(jax.device_get(state.step))
         if self.mode == "ema_bf16":
             payload = {
@@ -105,6 +231,9 @@ class CheckpointManager:
                               force=force)
 
     def latest_step(self) -> Optional[int]:
+        if self.mode == "full_sliced":
+            steps = self._sliced_steps()
+            return steps[-1] if steps else None
         return self._mgr.latest_step()
 
     def restore(self, abstract_state: TrainState,
@@ -114,11 +243,14 @@ class CheckpointManager:
         when no checkpoint exists (fresh run, like the reference's
         ``--transfer`` being absent).
 
-        Only valid for ``mode="full"`` directories — an ``ema_bf16``
-        directory has no optimizer state to restore; use
-        :meth:`restore_ema` (raises ValueError otherwise, rather than
-        silently handing back a half-initialized state).
+        Only valid for exact-resume directories (``full`` /
+        ``full_sliced``) — an ``ema_bf16`` directory has no optimizer
+        state to restore; use :meth:`restore_ema` (raises ValueError
+        otherwise, rather than silently handing back a half-initialized
+        state).
         """
+        if self.mode == "full_sliced":
+            return self._restore_sliced(abstract_state, step)
         if self.mode != "full":
             raise ValueError(
                 f"restore() on a mode={self.mode!r} checkpoint dir; use "
@@ -140,7 +272,7 @@ class CheckpointManager:
         anyway, so callers branch on :attr:`mode` (see
         ``cli/_common.py:load_eval_params`` for the mode-agnostic wrapper).
         """
-        if self.mode == "full":
+        if self.mode in ("full", "full_sliced"):
             raise ValueError(
                 "restore_ema() from a full checkpoint needs the whole "
                 "abstract TrainState; call restore() and read .ema_params")
@@ -161,7 +293,9 @@ class CheckpointManager:
         return int(restored["step"]), ema
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        if self._mgr is not None:       # sliced saves are synchronous
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
-        self._mgr.close()
+        if self._mgr is not None:
+            self._mgr.close()

@@ -1,0 +1,55 @@
+"""bench.py robustness layer: backend acquisition must survive transient
+faults (retry) and degrade to a parseable JSON error record, never a bare
+crash — round 4's official perf capture was voided by a single transient
+``UNAVAILABLE`` raised before any bench code ran."""
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import pytest
+
+import bench
+
+
+def test_acquire_backend_retries_transient_fault(monkeypatch):
+    calls = {"n": 0}
+    real_devices = jax.devices
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: TPU backend stalled")
+        return real_devices()
+
+    monkeypatch.setattr(jax, "devices", flaky)
+    devs = bench._acquire_backend(attempts=4, wait_s=0.01)
+    assert calls["n"] == 3 and len(devs) >= 1
+
+
+def test_acquire_backend_exhausts_and_raises(monkeypatch):
+    def always_down():
+        raise RuntimeError("UNAVAILABLE: still down")
+
+    monkeypatch.setattr(jax, "devices", always_down)
+    with pytest.raises(RuntimeError, match="still down"):
+        bench._acquire_backend(attempts=2, wait_s=0.01)
+
+
+def test_main_emits_parseable_json_when_backend_never_comes_up(
+        monkeypatch, capsys):
+    import json
+
+    def always_down():
+        raise RuntimeError("UNAVAILABLE: tunnel outage")
+
+    monkeypatch.setattr(jax, "devices", always_down)
+    monkeypatch.setattr(bench, "_acquire_backend",
+                        lambda: (_ for _ in ()).throw(
+                            RuntimeError("UNAVAILABLE: tunnel outage")))
+    assert bench.main() == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)          # MUST parse
+    assert rec["value"] is None and "UNAVAILABLE" in rec["error"]
+    assert rec["metric"].startswith("train_examples_per_sec")

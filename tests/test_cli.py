@@ -77,6 +77,51 @@ def test_train_then_sample_cli_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_eval_cli_resume_and_w_select(tmp_path):
+    """Outage-proofing + validation-selected guidance: each object's
+    synthesis lands on disk as it completes; a re-run skips completed
+    objects and produces the IDENTICAL final record; --w_select picks w
+    on objects disjoint from the eval set."""
+    from diff3d_tpu.cli import eval_cli
+
+    wd = str(tmp_path)
+    train_cli.main(["--synthetic", "--config", "test", "--steps", "2",
+                    "--batch", "8", "--workdir", wd, "--num_workers", "0"])
+    ckpt_root = os.path.join(wd, "checkpoints")
+
+    out = str(tmp_path / "eval.jsonl")
+    argv = ["--model", ckpt_root, "--synthetic_scenes", "--config", "test",
+            "--objects", "2", "--w_select", "1", "--steps", "2",
+            "--max_views", "3", "--out", out]
+    eval_cli.main(argv)
+    rec1 = json.loads(open(out).read().strip().splitlines()[-1])
+    assert rec1["objects"] == 2
+    assert 0 <= rec1["w_selected"] < len(rec1["psnr_per_w"])
+    # selection object is drawn AFTER the eval set — disjoint by design
+    assert rec1["w_select_objects"] == ["2"]
+    assert "psnr_margin_mean_w_selected" in rec1
+
+    objdir = out + ".objdir"
+    npzs = sorted(f for f in os.listdir(objdir) if f.endswith(".npz"))
+    # record names carry the checkpoint step (here 2): a later-step eval
+    # re-synthesises instead of tripping over stale records
+    assert npzs == ["obj_s2_0.npz", "obj_s2_1.npz", "obj_s2_2.npz"]
+    kept = os.path.getmtime(os.path.join(objdir, "obj_s2_0.npz"))
+    os.remove(os.path.join(objdir, "obj_s2_1.npz"))  # simulate lost obj
+
+    eval_cli.main(argv)  # resumes: only obj_1 is re-synthesised
+    rec2 = json.loads(open(out).read().strip().splitlines()[-1])
+    assert rec2 == rec1
+    assert os.path.getmtime(os.path.join(objdir, "obj_s2_0.npz")) == kept
+
+    # a record made under different settings must be refused, not mixed in
+    argv_other_steps = list(argv)
+    argv_other_steps[argv.index("--steps") + 1] = "4"
+    with pytest.raises(SystemExit, match="different settings"):
+        eval_cli.main(argv_other_steps)
+
+
+@pytest.mark.slow
 def test_eval_cli_end_to_end(tmp_path, capsys):
     """Train 2 steps, then score PSNR/SSIM/FID on a fake val object."""
     from diff3d_tpu.cli import eval_cli

@@ -37,17 +37,25 @@ def _time_windows(fn, sync, windows: int = 3):
     return times
 
 
-def _matmul_chain(M, N, K, dtype, steps):
+def _matmul_chain(M, N, K, dtype, steps, b_std: float):
     import jax
     import jax.numpy as jnp
+
+    # One c@b step scales magnitudes by ~ b_std * sqrt(K) (sum of K
+    # iid products); damp by its inverse so chain values stay in a
+    # NORMAL float range for all 64 steps.  The old fixed 1e-3 drove
+    # bf16 activations to zero within ~20 steps at large K — harmless
+    # on the MXU (timing is data-independent) but not the 'bounded
+    # magnitudes' the chain intends, and a backend with zero/denormal
+    # fast paths would skew the number (ADVICE r4).  The multiply still
+    # fuses into the matmul epilogue.
+    damp = 1.0 / (b_std * (K ** 0.5))
 
     def chain(a, b):
         def body(c, _):
             c = jax.lax.dot(c, b, precision=None,
                             preferred_element_type=dtype)
-            # keep magnitudes bounded without leaving the VPU; the
-            # multiply fuses into the matmul epilogue
-            return c * jnp.asarray(1e-3, dtype), None
+            return c * jnp.asarray(damp, dtype), None
 
         c, _ = jax.lax.scan(body, a, None, length=steps)
         return c
@@ -64,7 +72,7 @@ def measure_matmul(M, N, K, dtype_name: str, steps: int = 64):
     rs = np.random.RandomState(0)
     a = jnp.asarray(rs.randn(M, K) * 0.1, dtype)
     b = jnp.asarray(rs.randn(K, N) * 0.1, dtype)
-    fn = _matmul_chain(M, N, K, dtype, steps)
+    fn = _matmul_chain(M, N, K, dtype, steps, b_std=0.1)
     sync = lambda c: float(jnp.sum(c.astype(jnp.float32)))
     sync(fn(a, b))                                  # compile + warm
     times = _time_windows(lambda: fn(a, b), sync)
@@ -91,13 +99,19 @@ def measure_conv(B, H, W, Cin, Cout, k, dtype_name: str, steps: int = 32):
     if Cin != Cout:
         raise ValueError("chain needs Cin == Cout")
 
+    # Same normalising damping as _matmul_chain: one conv step scales
+    # magnitudes by ~ w_std * sqrt(k*k*Cin) (sum over the receptive
+    # field), so damp by its inverse to keep chain values in a normal
+    # float range instead of flushing bf16 activations to zero.
+    damp = 1.0 / (0.1 * (k * k * Cin) ** 0.5)
+
     def chain(x, w):
         def body(c, _):
             c = jax.lax.conv_general_dilated(
                 c, w, (1, 1), "SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 preferred_element_type=dtype)
-            return c * jnp.asarray(1e-2, dtype), None
+            return c * jnp.asarray(damp, dtype), None
 
         c, _ = jax.lax.scan(body, x, None, length=steps)
         return c
