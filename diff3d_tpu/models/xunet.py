@@ -95,7 +95,7 @@ class XUNet(nn.Module):
                 h = constrain(block_cls(
                     features=dim_out[i_level], use_attn=use_attn,
                     num_heads=cfg.attn_heads, dropout=cfg.dropout,
-                    attn_impl=cfg.attn_impl, dtype=dtype,
+                    attn_impl=cfg.attn_impl_at(i_level), dtype=dtype,
                     name=f"down_{i_level}_{i_block}")(h, emb, deterministic))
                 hs.append(h)
             if i_level != num_res - 1:
@@ -109,7 +109,7 @@ class XUNet(nn.Module):
         h = constrain(block_cls(
             features=dim_out[-1], use_attn=num_res in cfg.attn_levels,
             num_heads=cfg.attn_heads, dropout=cfg.dropout,
-            attn_impl=cfg.attn_impl, dtype=dtype,
+            attn_impl=cfg.attn_impl_at(num_res - 1), dtype=dtype,
             name="middle")(h, level_emb(num_res - 1), deterministic))
 
         # Up path (reference xunet.py:521-531): each block consumes
@@ -122,7 +122,7 @@ class XUNet(nn.Module):
                 h = constrain(block_cls(
                     features=dim_out[i_level], use_attn=use_attn,
                     num_heads=cfg.attn_heads, dropout=cfg.dropout,
-                    attn_impl=cfg.attn_impl, dtype=dtype,
+                    attn_impl=cfg.attn_impl_at(i_level), dtype=dtype,
                     name=f"up_{i_level}_{i_block}")(h, emb, deterministic))
             if i_level != 0:
                 h = constrain(resnet_cls(

@@ -253,3 +253,29 @@ def test_conditioning_encodings_stay_float32_in_bf16():
     e2, _ = cp.apply(variables, batch_with_logsnr(4.01), jnp.ones(1, bool))
     assert np.abs(np.asarray(e1, np.float32)
                   - np.asarray(e2, np.float32)).max() > 1e-3
+
+
+def test_attn_impl_levels_override():
+    """Per-level attention-engine override: all-'xla' levels match the
+    global attn_impl='xla' bitwise (same params, same math, different
+    plumbing), and validation rejects bad shapes/entries."""
+    cfg_global = tiny_cfg(attn_impl="xla")
+    cfg_levels = tiny_cfg(attn_impl="auto",
+                          attn_impl_levels=("xla", "xla", "xla", "xla"))
+    batch = make_batch(2, 16, 16)
+    cond = jnp.ones((2,), bool)
+    params = XUNet(cfg_global).init({"params": jax.random.PRNGKey(0)},
+                                    batch, cond_mask=cond)["params"]
+    out_g = XUNet(cfg_global).apply({"params": params}, batch,
+                                    cond_mask=cond)
+    out_l = XUNet(cfg_levels).apply({"params": params}, batch,
+                                    cond_mask=cond)
+    np.testing.assert_array_equal(np.asarray(out_g), np.asarray(out_l))
+    assert cfg_levels.attn_impl_at(1) == "xla"
+    assert cfg_levels.attn_impl_at(99) == "xla"   # middle clamps to last
+
+    with pytest.raises(ValueError, match="entries"):
+        tiny_cfg(attn_impl_levels=("xla",)).validate()
+    with pytest.raises(ValueError, match="invalid"):
+        tiny_cfg(attn_impl_levels=("xla", "bogus", "xla",
+                                   "xla")).validate()
