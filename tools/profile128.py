@@ -145,6 +145,12 @@ def main(argv=None):
     results = {"platform": platform, "sites": attention_sites(base.model),
                "train_variants": [], "attn_microbench": []}
 
+    def _flush():
+        # written after every measurement: a tunnel fault or window kill
+        # mid-run still leaves every completed datapoint on disk
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+
     def variant(name, global_batch, accum, attn_impl_levels=None):
         cfg = dataclasses.replace(
             base,
@@ -167,6 +173,7 @@ def main(argv=None):
                    "error": str(e).splitlines()[0][:200]}
         results["train_variants"].append(rec)
         print(json.dumps(rec), file=sys.stderr)
+        _flush()
 
     # Baseline = bench's srn128 config, then the two VERDICT levers.
     variant("b16x4_auto", 16, 4)
@@ -200,9 +207,9 @@ def main(argv=None):
                                "error": str(e).splitlines()[0][:200]}
                     results["attn_microbench"].append(rec)
                     print(json.dumps(rec), file=sys.stderr)
+                    _flush()
 
-    with open(args.out, "w") as f:
-        json.dump(results, f, indent=1)
+    _flush()
     print(json.dumps({"wrote": args.out,
                       "variants": len(results["train_variants"])}))
 
