@@ -262,7 +262,8 @@ class Trainer:
                     jax.profiler.stop_trace()
                     profiling = False
 
-                if step % cfg.log_every == 0 or step >= max_steps:
+                if ((cfg.log_every > 0 and step % cfg.log_every == 0)
+                        or step >= max_steps):
                     jax.block_until_ready(metrics["loss"])
                     now = time.monotonic()
                     dt = max(now - window_t, 1e-9)
@@ -287,7 +288,11 @@ class Trainer:
                             "last finite checkpoint preserved")
 
                 saved_this_step = False
-                if step % cfg.ckpt_every == 0 or step >= max_steps:
+                # ckpt_every <= 0 disables periodic saves (the final-step
+                # and preemption saves still run) instead of crashing on
+                # a modulo-by-zero
+                if ((cfg.ckpt_every > 0 and step % cfg.ckpt_every == 0)
+                        or step >= max_steps):
                     # Never persist a poisoned state: ckpt cadence need not
                     # align with log cadence, so check this step's health
                     # here too.  grad_norm covers the finite-loss /
@@ -328,6 +333,17 @@ class Trainer:
                     # the finished checkpoint, reopening the loss window a
                     # mid-rewrite SIGKILL was supposed to be protected from.
                     if not saved_this_step:
+                        # The periodic branches carry the NaN guard; with
+                        # log/ckpt cadences disabled nothing has checked
+                        # this step, and the preemption save must uphold
+                        # "never persist a poisoned state" on its own.
+                        loss = float(metrics["loss"])
+                        gnorm = float(metrics["grad_norm"])
+                        if not (np.isfinite(loss) and np.isfinite(gnorm)):
+                            raise FloatingPointError(
+                                f"non-finite loss {loss} / grad_norm "
+                                f"{gnorm} at preemption (step {step}); "
+                                "last finite checkpoint preserved")
                         self.ckpt.save(self.state, force=True)
                     log.warning("preempted at step %d; state saved", step)
                     break

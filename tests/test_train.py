@@ -570,3 +570,17 @@ def test_context_parallel_requires_model_axis():
     cfg = dataclasses.replace(cfg, mesh=MeshConfig(context_parallel=True))
     with pytest.raises(ValueError, match="model_parallel"):
         cfg.validate()
+
+
+def test_trainer_ckpt_every_zero_disables_periodic_saves(tmp_path):
+    """ckpt_every=0 means 'no periodic saves' (final-step save still
+    runs) — it used to crash with a modulo-by-zero inside the loop."""
+    cfg = tiny_cfg(max_steps=2, ckpt_every=0, log_every=0)
+    ds = SyntheticDataset(num_objects=2, num_views=4,
+                          imgsize=cfg.model.H)
+    loader = InfiniteLoader(ds, cfg.train.global_batch, num_workers=0)
+    tr = Trainer(cfg, loader, workdir=str(tmp_path))
+    tr.train()
+    assert int(tr.state.step) == 2
+    # the end-of-run save still happened
+    assert tr.ckpt.latest_step() == 2

@@ -147,9 +147,12 @@ def main(argv=None):
 
     def _flush():
         # written after every measurement: a tunnel fault or window kill
-        # mid-run still leaves every completed datapoint on disk
-        with open(args.out, "w") as f:
+        # mid-run still leaves every completed datapoint on disk.
+        # tmp + rename so a kill mid-write can't truncate earlier data.
+        import os
+        with open(args.out + ".tmp", "w") as f:
             json.dump(results, f, indent=1)
+        os.replace(args.out + ".tmp", args.out)
 
     def variant(name, global_batch, accum, attn_impl_levels=None):
         cfg = dataclasses.replace(
