@@ -120,6 +120,19 @@ def test_eval_cli_resume_and_w_select(tmp_path):
     with pytest.raises(SystemExit, match="different settings"):
         eval_cli.main(argv_other_steps)
 
+    # longitudinal workflow: train further, re-run the SAME eval command
+    # — the new checkpoint step keys fresh records (stale ones ignored,
+    # not a fatal protocol conflict)
+    train_cli.main(["--synthetic", "--config", "test", "--steps", "4",
+                    "--batch", "8", "--workdir", wd, "--num_workers", "0",
+                    "--transfer"])
+    eval_cli.main(argv)
+    rec3 = json.loads(open(out).read().strip().splitlines()[-1])
+    assert rec3["checkpoint_step"] == 4
+    npzs = sorted(f for f in os.listdir(objdir) if f.endswith(".npz"))
+    assert [f for f in npzs if f.startswith("obj_s4_")] == [
+        "obj_s4_0.npz", "obj_s4_1.npz", "obj_s4_2.npz"]
+
 
 @pytest.mark.slow
 def test_eval_cli_end_to_end(tmp_path, capsys):
