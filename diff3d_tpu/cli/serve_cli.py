@@ -1,0 +1,159 @@
+"""Long-running novel-view inference service.
+
+Loads a checkpoint and serves ``POST /synthesize`` — concurrent requests
+are microbatched into shared compiled scans (``diff3d_tpu/serving``), so
+the chip stays occupied under live load instead of running one request's
+underfilled guidance sweep at a time.
+
+Usage:
+    python -m diff3d_tpu.cli.serve_cli --model ./checkpoints \
+        [--config srn64] [--port 8080] [--max_batch 8] [--max_wait_ms 50]
+
+    # smoke-serve random-init params (no checkpoint; CPU-friendly):
+    python -m diff3d_tpu.cli.serve_cli --init random --config test
+
+Endpoints: ``POST /synthesize``, ``GET /result/<id>``, ``GET /healthz``,
+``GET /metrics`` (text; ``?format=json`` for the structured snapshot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from diff3d_tpu.cli._common import (add_model_width_args,
+                                    apply_model_width_overrides,
+                                    build_abstract_state,
+                                    load_eval_params)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default=None,
+                   help="checkpoint directory (Orbax root); omit with "
+                        "--init random")
+    p.add_argument("--init", choices=["checkpoint", "random"],
+                   default="checkpoint",
+                   help="'random' serves freshly initialised params — "
+                        "for smoke tests and load benches, no --model "
+                        "needed")
+    p.add_argument("--config", choices=["srn64", "srn128", "test"],
+                   default="srn64")
+    p.add_argument("--host", default=None,
+                   help="bind address (default: config, 127.0.0.1)")
+    p.add_argument("--port", type=int, default=None,
+                   help="bind port (default: config, 8080; 0 = ephemeral)")
+    p.add_argument("--max_batch", type=int, default=None,
+                   help="device-batch lane ceiling per shape bucket")
+    p.add_argument("--max_wait_ms", type=float, default=None,
+                   help="microbatch flush deadline after the first "
+                        "request of a bucket arrives")
+    p.add_argument("--max_queue", type=int, default=None,
+                   help="bounded queue size; beyond it submissions get "
+                        "HTTP 429")
+    p.add_argument("--timeout_s", type=float, default=None,
+                   help="default per-request deadline")
+    p.add_argument("--steps", type=int, default=None,
+                   help="diffusion steps per view (reference: 256)")
+    p.add_argument("--scan_chunks", type=int, default=1,
+                   help="split each view's diffusion scan into this many "
+                        "device executions (must divide --steps)")
+    p.add_argument("--raw_params", action="store_true",
+                   help="serve raw params instead of EMA")
+    p.add_argument("--warmup", action="store_true",
+                   help="pre-compile the single-lane program for the "
+                        "max_views bucket before accepting traffic")
+    add_model_width_args(p)
+    return p
+
+
+def build_service(args):
+    """Config + params + sampler -> ServingService (not started)."""
+    import dataclasses
+
+    import jax
+
+    from diff3d_tpu import config as config_lib
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.sampling import Sampler, record_capacity
+    from diff3d_tpu.serving import ServingService
+
+    cfg = {"srn64": config_lib.srn64_config,
+           "srn128": config_lib.srn128_config,
+           "test": config_lib.test_config}[args.config]()
+    if args.steps:
+        cfg = dataclasses.replace(
+            cfg, diffusion=dataclasses.replace(cfg.diffusion,
+                                               timesteps=args.steps))
+    cfg = apply_model_width_overrides(cfg, args)
+    over = {k: getattr(args, k) for k in
+            ("host", "port", "max_batch", "max_queue")
+            if getattr(args, k) is not None}
+    if args.max_wait_ms is not None:
+        over["max_wait_ms"] = args.max_wait_ms
+    if args.timeout_s is not None:
+        over["default_timeout_s"] = args.timeout_s
+    if over:
+        cfg = dataclasses.replace(
+            cfg, serving=dataclasses.replace(cfg.serving, **over))
+    cfg.validate()
+
+    model = XUNet(cfg.model)
+    if args.init == "random":
+        from diff3d_tpu.train.trainer import init_params
+
+        params = init_params(model, cfg, jax.random.PRNGKey(0))
+        step, version = 0, "random-init"
+    else:
+        if not args.model:
+            raise SystemExit("--model is required unless --init random")
+        try:
+            step, params = load_eval_params(args.model,
+                                            build_abstract_state(cfg),
+                                            args.raw_params)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        version = f"{args.model}@step{step}"
+    logging.info("serving %s params (step %d)", version, step)
+
+    sampler = Sampler(model, params, cfg, scan_chunks=args.scan_chunks)
+    service = ServingService(sampler, cfg, params_version=version)
+    if args.warmup:
+        bucket = (cfg.model.H, cfg.model.W,
+                  record_capacity(cfg.serving.max_views))
+        secs = service.engine.programs.warmup(bucket, 1,
+                                              sampler.w.shape[0])
+        logging.info("warmed bucket %s in %.1fs", bucket, secs)
+    return service
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    logging.getLogger("absl").setLevel(logging.WARNING)
+
+    service = build_service(args)
+    service.start(serve_http=True)
+    logging.info("listening on http://%s:%d (POST /synthesize, "
+                 "GET /healthz, GET /metrics)",
+                 service.cfg.serving.host, service.port)
+
+    done = threading.Event()
+
+    def _sig(signum, frame):
+        logging.info("signal %d: shutting down", signum)
+        done.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        done.wait()
+    finally:
+        service.stop()
+        logging.info("stopped")
+
+
+if __name__ == "__main__":
+    main()

@@ -193,15 +193,65 @@ class MeshConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Batched novel-view inference service (``diff3d_tpu/serving``).
+
+    The service shares the chip across concurrent requests by microbatching
+    them into fixed-shape device batches (bucketed by image size and record
+    capacity) and admitting new requests between view steps (continuous
+    batching at view granularity).  No reference counterpart — the
+    reference stops at a one-shot offline sampler (``sampling.py:169-184``).
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 8080
+    # Backpressure: submissions beyond this many pending requests are
+    # REJECTED (HTTP 429), never silently queued without bound.
+    max_queue: int = 64
+    # Device-batch lane ceiling per bucket; the engine pads the active set
+    # up to the next power of two <= max_batch (logarithmic number of
+    # compiled programs per bucket, same trick as the record capacity).
+    max_batch: int = 8
+    # Microbatcher flush deadline: after the first request of a bucket
+    # arrives, wait at most this long for co-batchable requests before
+    # launching underfull.
+    max_wait_ms: float = 50.0
+    # Per-request wall-clock deadline (queue wait + compute); expired
+    # requests get an explicit timeout error, not a hang.
+    default_timeout_s: float = 300.0
+    # LRU result cache entries keyed by request content hash (0 disables).
+    result_cache_entries: int = 32
+    # Per-request view-count ceiling (bounds record capacity / HBM).
+    max_views: int = 16
+
+    def validate(self) -> None:
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch={self.max_batch} must be >= 1")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue={self.max_queue} must be >= 1")
+        if self.max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms={self.max_wait_ms} must be >= 0")
+        if self.default_timeout_s <= 0:
+            raise ValueError(
+                f"default_timeout_s={self.default_timeout_s} must be > 0")
+        if self.max_views < 2:
+            raise ValueError(
+                f"max_views={self.max_views} must be >= 2 (one "
+                "conditioning view + one target)")
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     diffusion: DiffusionConfig = dataclasses.field(default_factory=DiffusionConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
 
     def validate(self) -> None:
         self.model.validate()
+        self.serving.validate()
         if self.mesh.context_parallel and self.mesh.model_parallel <= 1:
             raise ValueError(
                 "context_parallel shards the spatial axis over the model "

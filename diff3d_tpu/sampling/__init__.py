@@ -1,3 +1,4 @@
-from diff3d_tpu.sampling.runtime import Sampler, save_image_grid
+from diff3d_tpu.sampling.runtime import (Sampler, record_capacity,
+                                         save_image)
 
-__all__ = ["Sampler", "save_image_grid"]
+__all__ = ["Sampler", "record_capacity", "save_image"]

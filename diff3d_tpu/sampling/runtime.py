@@ -17,6 +17,13 @@ TPU-native architecture (vs the reference's per-step host round-trips,
     model call;
   * the Python view loop only swaps the record buffer between scans, so
     one jit compilation serves every view.
+
+The per-view unit of work is public API: :meth:`Sampler.step` (one object)
+and :meth:`Sampler.step_many` (N objects, per-object view steps) run one
+view's full reverse diffusion; ``synthesize``/``synthesize_many`` are thin
+host loops over them.  The serving layer (``diff3d_tpu/serving``) drives
+``step_many`` directly so live requests at *different* autoregressive
+depths share one compiled scan (continuous batching at view granularity).
 """
 
 from __future__ import annotations
@@ -39,12 +46,30 @@ def to_uint8(img: np.ndarray) -> np.ndarray:
     return np.clip((np.asarray(img) + 1.0) * 127.5, 0, 255).astype(np.uint8)
 
 
-def save_image_grid(path: str, imgs: np.ndarray) -> None:
-    """Save ``[H, W, 3]`` (single) images; parent dirs created."""
+def save_image(path: str, img: np.ndarray) -> None:
+    """Save one ``[H, W, 3]`` image in [-1, 1]; parent dirs created."""
     from PIL import Image
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    Image.fromarray(to_uint8(imgs)).save(path)
+    Image.fromarray(to_uint8(img)).save(path)
+
+
+def record_capacity(n_views: int) -> int:
+    """Record-buffer capacity for an object synthesised to ``n_views``
+    total views.
+
+    Rounds up to a power of two: the compiled scan's shape depends on the
+    record capacity, so objects with different view counts share a
+    logarithmic number of compilations instead of one each.  The
+    stochastic-conditioning draw only sees the first ``record_len``
+    entries, so padding never leaks into sampling.  The serving layer's
+    shape buckets use the same function, so a served request compiles (and
+    caches) the exact program the offline path uses.
+    """
+    if n_views < 2:
+        raise ValueError(f"n_views={n_views}: need at least 2 views "
+                         "(one conditioning + one target)")
+    return 1 << (n_views - 1).bit_length()
 
 
 class Sampler:
@@ -52,7 +77,10 @@ class Sampler:
 
     Args:
       model: the X-UNet.
-      params: trained parameters (typically the EMA pytree).
+      params: trained parameters (typically the EMA pytree).  Held as the
+        *default* — every compiled entry point takes params as a jit
+        argument, so callers (checkpoint hot-swap in serving) may pass a
+        different same-shaped pytree per call without recompiling.
       cfg: full config (diffusion.timesteps, guidance_weights, ...).
       scan_chunks: split each view's reverse-diffusion scan into this many
         consecutive device executions (bit-identical result — the RNG
@@ -115,20 +143,19 @@ class Sampler:
                 logsnr_max=d.logsnr_max, clip_x0=d.clip_x0)
 
         if scan_chunks == 1:
-            self._jitted = jax.jit(run)
-            self._run = lambda *args: self._jitted(self.params, *args)
+            self._run = jax.jit(run)
         else:
             jit_prepare = jax.jit(prepare)
             jit_chunk = jax.jit(chunk)
             n_per = d.timesteps // scan_chunks
 
-            def run_chunked(record_imgs, record_R, record_T, record_len,
-                            target_R, target_T, K, rng):
+            def run_chunked(params, record_imgs, record_R, record_T,
+                            record_len, target_R, target_T, K, rng):
                 state, xs = jit_prepare(record_len, rng, record_imgs)
                 for c in range(scan_chunks):
                     sl = jax.tree.map(
                         lambda x: x[c * n_per:(c + 1) * n_per], xs)
-                    state = jit_chunk(self.params, state, sl, record_imgs,
+                    state = jit_chunk(params, state, sl, record_imgs,
                                       record_R, record_T, target_R,
                                       target_T, K)
                 return state.img
@@ -138,21 +165,21 @@ class Sampler:
         # into every model call (N*2B examples instead of 2B), so N
         # independent objects' guidance sweeps share one compiled scan —
         # at 64^2 the per-object batch of 8 underfills the chip and the
-        # per-object loop was the eval cost center.  record_len (= view
-        # step, shared across objects) stays unbatched.
+        # per-object loop was the eval cost center.  record_len is batched
+        # per object (in_axes 0): the offline path passes the same step
+        # for every object, while the serving engine mixes requests at
+        # different autoregressive depths in one device batch.
         if scan_chunks == 1:
-            self._jitted_many = jax.jit(jax.vmap(
-                run, in_axes=(None, 0, 0, 0, None, 0, 0, 0, 0)))
-            self._run_many = lambda *args: self._jitted_many(self.params,
-                                                             *args)
+            self._run_many = jax.jit(jax.vmap(
+                run, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0)))
         else:
             jit_prepare_many = jax.jit(jax.vmap(prepare,
-                                                in_axes=(None, 0, 0)))
+                                                in_axes=(0, 0, 0)))
             jit_chunk_many = jax.jit(jax.vmap(
                 chunk, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0)))
             n_per_many = d.timesteps // scan_chunks
 
-            def run_many_chunked(record_imgs, record_R, record_T,
+            def run_many_chunked(params, record_imgs, record_R, record_T,
                                  record_len, target_R, target_T, K, rngs):
                 state, xs = jit_prepare_many(record_len, rngs, record_imgs)
                 for c in range(scan_chunks):
@@ -160,11 +187,60 @@ class Sampler:
                         lambda x: x[:, c * n_per_many:(c + 1) * n_per_many],
                         xs)
                     state = jit_chunk_many(
-                        self.params, state, sl, record_imgs, record_R,
+                        params, state, sl, record_imgs, record_R,
                         record_T, target_R, target_T, K)
                 return state.img
 
             self._run_many = run_many_chunked
+
+    # ------------------------------------------------------------------
+    # Per-view step API (public): one view's full reverse diffusion.
+    # ------------------------------------------------------------------
+
+    def step(self, record_imgs, record_R, record_T, step, target_R,
+             target_T, K, key, *, params=None):
+        """One view's reverse diffusion for ONE object.
+
+        Args:
+          record_imgs / record_R / record_T: ``[capacity, B, H, W, 3]`` /
+            ``[capacity, 3, 3]`` / ``[capacity, 3]`` record buffers
+            (see :func:`record_capacity`).
+          step: number of valid record entries (== the view index being
+            synthesised).
+          target_R / target_T: pose of the view to synthesise.
+          K: ``[3, 3]`` intrinsics.
+          key: per-view PRNG key.
+          params: optional parameter pytree overriding the constructor
+            default (same treedef/shapes — no recompile).
+        Returns:
+          ``[B, H, W, 3]`` device array (not fetched; callers block).
+        """
+        p = self.params if params is None else params
+        return self._run(p, jnp.asarray(record_imgs),
+                         jnp.asarray(record_R), jnp.asarray(record_T),
+                         jnp.asarray(step), jnp.asarray(target_R),
+                         jnp.asarray(target_T), jnp.asarray(K), key)
+
+    def step_many(self, record_imgs, record_R, record_T, steps, target_R,
+                  target_T, K, keys, *, params=None):
+        """One view step for N objects in ONE batched program.
+
+        Everything gains a leading object axis; ``steps`` is ``[N]`` —
+        per-object record lengths, so co-batched objects may sit at
+        different autoregressive depths (the serving engine's continuous
+        batching relies on this).  ``keys`` is ``[N]`` stacked PRNG keys.
+        Returns ``[N, B, H, W, 3]`` (device array).
+        """
+        p = self.params if params is None else params
+        return self._run_many(
+            p, jnp.asarray(record_imgs), jnp.asarray(record_R),
+            jnp.asarray(record_T), jnp.asarray(steps),
+            jnp.asarray(target_R), jnp.asarray(target_T),
+            jnp.asarray(K), keys)
+
+    # ------------------------------------------------------------------
+    # Offline loops: thin host loops over the step API.
+    # ------------------------------------------------------------------
 
     def synthesize(self, views: Dict[str, np.ndarray], rng: jax.Array,
                    out_dir: Optional[str] = None,
@@ -186,12 +262,7 @@ class Sampler:
 
         # Fixed-size record buffer; entry 0 is the GT first view repeated
         # across the guidance batch (reference sampling.py:160-162).
-        # Capacity rounds up to a power of two: the compiled scan's shape
-        # depends on it, so objects with different view counts share a
-        # logarithmic number of compilations instead of one each.  The
-        # stochastic-conditioning draw only sees the first `record_len`
-        # entries, so padding never leaks into sampling.
-        capacity = 1 << (n_views - 1).bit_length()
+        capacity = record_capacity(n_views) if n_views > 1 else 1
         record_imgs = np.zeros((capacity, B, H, W, 3), np.float32)
         record_R = np.zeros((capacity, 3, 3), np.float32)
         record_T = np.zeros((capacity, 3), np.float32)
@@ -201,20 +272,18 @@ class Sampler:
         outs = []
         for step in range(1, n_views):
             rng, k = jax.random.split(rng)
-            out = self._run(jnp.asarray(record_imgs), jnp.asarray(record_R),
-                            jnp.asarray(record_T), jnp.asarray(step),
-                            jnp.asarray(R[step]), jnp.asarray(T[step]),
-                            K, k)
+            out = self.step(record_imgs, record_R, record_T, step,
+                            R[step], T[step], K, k)
             out = np.asarray(jax.block_until_ready(out))
             record_imgs[step] = out
             record_R[step], record_T[step] = R[step], T[step]
             outs.append(out)
 
             if out_dir is not None:
-                save_image_grid(os.path.join(out_dir, str(step), "gt.png"),
-                                imgs[step])
+                save_image(os.path.join(out_dir, str(step), "gt.png"),
+                           imgs[step])
                 for i in range(B):
-                    save_image_grid(
+                    save_image(
                         os.path.join(out_dir, str(step), f"{i}.png"), out[i])
         return np.stack(outs) if outs else np.zeros((0, B, H, W, 3))
 
@@ -244,7 +313,7 @@ class Sampler:
         B = self.w.shape[0]
         H, W = views_list[0]["imgs"].shape[1:3]
 
-        capacity = 1 << (n_views - 1).bit_length()
+        capacity = record_capacity(n_views) if n_views > 1 else 1
         record_imgs = np.zeros((N, capacity, B, H, W, 3), np.float32)
         record_R = np.zeros((N, capacity, 3, 3), np.float32)
         record_T = np.zeros((N, capacity, 3), np.float32)
@@ -262,11 +331,10 @@ class Sampler:
         for step in range(1, n_views):
             split = jax.vmap(jax.random.split)(keys)     # [N, 2, key]
             keys, step_keys = split[:, 0], split[:, 1]
-            out = self._run_many(
-                jnp.asarray(record_imgs), jnp.asarray(record_R),
-                jnp.asarray(record_T), jnp.asarray(step),
-                jnp.asarray(Rs[:, step]), jnp.asarray(Ts[:, step]),
-                jnp.asarray(Ks), step_keys)
+            out = self.step_many(
+                record_imgs, record_R, record_T,
+                np.full((N,), step, np.int32),
+                Rs[:, step], Ts[:, step], Ks, step_keys)
             out = np.asarray(jax.block_until_ready(out))  # [N, B, H, W, 3]
             record_imgs[:, step] = out
             record_R[:, step], record_T[:, step] = Rs[:, step], Ts[:, step]

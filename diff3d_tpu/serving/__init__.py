@@ -1,0 +1,27 @@
+"""Batched novel-view inference service.
+
+Turns the offline :class:`diff3d_tpu.sampling.Sampler` into a long-running
+service: a bounded scheduler microbatches concurrent requests into
+fixed-shape device batches (bucketed by image size and record capacity), a
+device-executor engine drives the object-batched per-view scan and admits
+new requests *between* views (continuous batching at view granularity —
+3DiM's 256-step-per-view sampler makes per-request latency batch-bound,
+not step-bound), and a stdlib HTTP frontend exposes submit/poll, health
+and metrics endpoints.
+"""
+
+from diff3d_tpu.serving.cache import (ParamsRegistry, ProgramCache,
+                                      ResultCache)
+from diff3d_tpu.serving.engine import Engine
+from diff3d_tpu.serving.metrics import MetricsRegistry
+from diff3d_tpu.serving.scheduler import (Bucket, QueueFullError,
+                                          RequestCancelled, RequestTimeout,
+                                          Scheduler, ViewRequest)
+from diff3d_tpu.serving.server import ServingService, make_http_server
+
+__all__ = [
+    "Bucket", "Engine", "MetricsRegistry", "ParamsRegistry",
+    "ProgramCache", "QueueFullError", "RequestCancelled", "RequestTimeout",
+    "ResultCache", "Scheduler", "ServingService", "ViewRequest",
+    "make_http_server",
+]

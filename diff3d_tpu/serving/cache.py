@@ -1,0 +1,196 @@
+"""Caches for the inference service: compiled programs, params, results.
+
+Three independent layers, cheapest first:
+
+  * :class:`ResultCache` — LRU over full request results keyed by content
+    hash (inputs + seed + params version).  The sampler is deterministic
+    given the key, so a replayed request costs a dict lookup instead of
+    ``256 * (n_views-1)`` model calls.
+  * :class:`ProgramCache` — the executable cache is jax's own jit cache
+    (keyed by input shapes); this layer pins the *key space* to the
+    engine's ``(bucket, lanes)`` grid, warms shapes ahead of traffic, and
+    counts compiles vs. reuses so padding policy changes show up in
+    ``/metrics`` instead of as mystery latency spikes.
+  * :class:`ParamsRegistry` — hot checkpoint swap.  ``Sampler`` takes
+    params as a jit *argument* (``sampling/runtime.py``), so installing a
+    new same-shaped pytree changes zero compiled programs; the registry
+    adds the atomicity (a view step runs entirely on one version) and the
+    shape guard (a mismatched tree fails at swap time with a clear error,
+    not mid-request with an XLA shape error).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class ParamsRegistry:
+    """Versioned, atomically swappable parameter pytree."""
+
+    def __init__(self, params, version: str = "v0"):
+        self._lock = threading.Lock()
+        self._params = params
+        self._version = version
+        self._template = [(l.shape, l.dtype)
+                          for l in jax.tree.leaves(params)]
+        self._treedef = jax.tree.structure(params)
+        self.swaps = 0
+
+    def current(self) -> Tuple[str, Any]:
+        with self._lock:
+            return self._version, self._params
+
+    @property
+    def version(self) -> str:
+        with self._lock:
+            return self._version
+
+    def swap(self, params, version: Optional[str] = None) -> str:
+        """Install new params; every *subsequent* view step uses them
+        (in-flight steps finish on the old version).  Raises ``ValueError``
+        on any structure/shape/dtype mismatch — the compiled programs are
+        specialised to the template, so a mismatch would recompile at best
+        and crash mid-request at worst."""
+        treedef = jax.tree.structure(params)
+        if treedef != self._treedef:
+            raise ValueError(
+                f"params tree structure mismatch: {treedef} != "
+                f"{self._treedef}")
+        got = [(l.shape, l.dtype) for l in jax.tree.leaves(params)]
+        for i, (new, old) in enumerate(zip(got, self._template)):
+            if new != old:
+                raise ValueError(
+                    f"params leaf {i} shape/dtype mismatch: {new} != {old}")
+        with self._lock:
+            self.swaps += 1
+            self._version = version or f"v{self.swaps}"
+            self._params = params
+            return self._version
+
+
+class ProgramCache:
+    """Tracks the compiled view-step programs by ``(bucket, lanes)``.
+
+    jax's jit cache holds the executables; first use of a new key is a
+    trace+compile (timed and counted here), later uses are cache hits.
+    """
+
+    def __init__(self, sampler, metrics=None):
+        self._sampler = sampler
+        self._lock = threading.Lock()
+        self._programs: Dict[tuple, dict] = {}
+        m = metrics
+        self._compiles = m.counter(
+            "serving_program_compiles_total",
+            "distinct (bucket, lanes) programs compiled") if m else None
+        self._hits = m.counter(
+            "serving_program_hits_total",
+            "view steps served by an already-compiled program") if m \
+            else None
+
+    def step_many(self, bucket, lanes: int, record_imgs, record_R,
+                  record_T, steps, target_R, target_T, K, keys, *,
+                  params=None):
+        key = (tuple(bucket), int(lanes))
+        with self._lock:
+            entry = self._programs.get(key)
+            first = entry is None
+            if first:
+                entry = self._programs[key] = {"compile_s": None, "uses": 0}
+            entry["uses"] += 1
+        if first and self._compiles:
+            self._compiles.inc()
+        if not first and self._hits:
+            self._hits.inc()
+        t0 = time.monotonic()
+        out = self._sampler.step_many(record_imgs, record_R, record_T,
+                                      steps, target_R, target_T, K, keys,
+                                      params=params)
+        if first:
+            out = jax.block_until_ready(out)
+            with self._lock:
+                self._programs[key]["compile_s"] = time.monotonic() - t0
+        return out
+
+    def warmup(self, bucket, lanes: int, guidance_B: int, *,
+               params=None) -> float:
+        """Compile the ``(bucket, lanes)`` program on zeros ahead of
+        traffic; returns the wall seconds spent (0 if already cached)."""
+        key = (tuple(bucket), int(lanes))
+        with self._lock:
+            if key in self._programs:
+                return 0.0
+        H, W, cap = bucket
+        N = int(lanes)
+        t0 = time.monotonic()
+        out = self.step_many(
+            bucket, lanes,
+            np.zeros((N, cap, guidance_B, H, W, 3), np.float32),
+            np.zeros((N, cap, 3, 3), np.float32),
+            np.zeros((N, cap, 3), np.float32),
+            np.ones((N,), np.int32),
+            np.zeros((N, 3, 3), np.float32),
+            np.zeros((N, 3), np.float32),
+            np.zeros((N, 3, 3), np.float32),
+            jax.numpy.stack([jax.random.PRNGKey(i) for i in range(N)]),
+            params=params)
+        jax.block_until_ready(out)
+        return time.monotonic() - t0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "programs": {
+                    f"H{k[0][0]}xW{k[0][1]}xcap{k[0][2]}xlanes{k[1]}": {
+                        "uses": v["uses"],
+                        "compile_s": v["compile_s"],
+                    } for k, v in self._programs.items()
+                },
+                "num_programs": len(self._programs),
+            }
+
+
+class ResultCache:
+    """Thread-safe LRU of completed request results.
+
+    Keys come from :meth:`ViewRequest.content_key` (inputs + seed + params
+    version); values are the ``[n_views-1, B, H, W, 3]`` output arrays.
+    ``capacity=0`` disables caching entirely.
+    """
+
+    def __init__(self, capacity: int = 32, metrics=None):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        m = metrics
+        self._hit_ctr = m.counter(
+            "serving_result_cache_hits_total",
+            "requests answered from the result cache") if m else None
+
+    def get(self, key: str) -> Optional[np.ndarray]:
+        with self._lock:
+            val = self._entries.get(key)
+            if val is not None:
+                self._entries.move_to_end(key)
+                if self._hit_ctr:
+                    self._hit_ctr.inc()
+            return val
+
+    def put(self, key: str, value: np.ndarray) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,290 @@
+"""Device-executor engine: continuous batching at view granularity.
+
+One thread owns the chip.  Its loop is:
+
+    admit pending requests (same bucket) into free lanes
+      -> run ONE view's reverse diffusion for every active request
+         (one ``Sampler.step_many`` launch; 256 fused steps inside)
+      -> write each lane's view back into its request's record buffer,
+         resolve finished requests, free their lanes
+      -> repeat
+
+Because admission happens *between* view steps, a freshly submitted
+1-view request rides along with an in-flight 20-view job at the very next
+view boundary instead of waiting behind it — iteration-level (Orca-style)
+scheduling where the iteration is a whole fixed-length diffusion scan, the
+natural preemption point of 3DiM's sampler (a scan cannot be split without
+changing the compiled program).
+
+Each request keeps the exact RNG stream of the offline path: a per-request
+``PRNGKey(seed)`` split once per view (``sampling/runtime.py
+synthesize``), so a served result is bit-identical to
+``Sampler.synthesize`` with the same seed on the same backend.
+
+Batch shapes are quantised: the active set is padded to the next power of
+two lanes (<= ``ServingConfig.max_batch``) by repeating a live lane, so
+each bucket owns a logarithmic number of compiled programs.  Padding lanes
+burn real FLOPs — the occupancy/padding histograms exist precisely to make
+that waste visible.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from diff3d_tpu.config import ServingConfig
+from diff3d_tpu.serving.cache import (ParamsRegistry, ProgramCache,
+                                      ResultCache)
+from diff3d_tpu.serving.metrics import MetricsRegistry
+from diff3d_tpu.serving.scheduler import (RequestCancelled, RequestTimeout,
+                                          Scheduler, ViewRequest)
+from diff3d_tpu.utils.profiling import StepTimer
+
+log = logging.getLogger(__name__)
+
+
+def _pow2_lanes(n: int, max_batch: int) -> int:
+    """Smallest power of two >= n, clamped to max_batch."""
+    return min(1 << (n - 1).bit_length(), max_batch) if n else 0
+
+
+class _Slot:
+    """Engine-side state of one admitted request."""
+
+    def __init__(self, req: ViewRequest, guidance_B: int):
+        self.req = req
+        cap = req.bucket.capacity
+        H, W = req.bucket.H, req.bucket.W
+        self.record_imgs = np.zeros((cap, guidance_B, H, W, 3), np.float32)
+        self.record_R = np.zeros((cap, 3, 3), np.float32)
+        self.record_T = np.zeros((cap, 3), np.float32)
+        self.record_imgs[0] = req.imgs0[None]
+        self.record_R[0], self.record_T[0] = req.R[0], req.T[0]
+        self.step = 1                       # next view index to synthesise
+        self.rng = jax.random.PRNGKey(req.seed)
+        self.outs: List[np.ndarray] = []
+
+
+class Engine:
+    """Single consumer of the :class:`Scheduler`; owner of device work."""
+
+    def __init__(self, sampler, scheduler: Scheduler,
+                 metrics: MetricsRegistry, cfg: ServingConfig,
+                 params_registry: Optional[ParamsRegistry] = None,
+                 result_cache: Optional[ResultCache] = None,
+                 program_cache: Optional[ProgramCache] = None):
+        self.sampler = sampler
+        self.scheduler = scheduler
+        self.metrics = metrics
+        self.cfg = cfg
+        self.registry = params_registry or ParamsRegistry(sampler.params)
+        self.result_cache = result_cache or ResultCache(
+            cfg.result_cache_entries, metrics)
+        self.programs = program_cache or ProgramCache(sampler, metrics)
+        self.guidance_B = int(sampler.w.shape[0])
+        self.step_timer = StepTimer(window=512)
+
+        m = metrics
+        self._submitted = m.counter("serving_requests_total",
+                                    "requests accepted for scheduling")
+        self._completed = m.counter("serving_requests_completed_total",
+                                    "requests finished successfully")
+        self._failed = m.counter("serving_requests_failed_total",
+                                 "requests resolved with an error")
+        self._views_done = m.counter("serving_views_completed_total",
+                                     "novel views synthesised")
+        self._active_g = m.gauge("serving_active_requests",
+                                 "requests currently holding a lane")
+        self._occupancy = m.histogram(
+            "serving_batch_occupancy",
+            "live requests per launched view-step batch")
+        self._padding = m.histogram(
+            "serving_batch_padding_fraction",
+            "fraction of launched lanes that were padding")
+        self._ttfv = m.histogram(
+            "serving_time_to_first_view_seconds",
+            "submit -> first synthesised view")
+        self._view_lat = m.histogram("serving_view_step_seconds",
+                                     "wall time of one view-step batch")
+        self._e2e = m.histogram("serving_e2e_latency_seconds",
+                                "submit -> full result")
+        self._queue_wait = m.histogram("serving_queue_wait_seconds",
+                                       "submit -> admission to a lane")
+
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- client surface --------------------------------------------------
+
+    def submit(self, req: ViewRequest) -> ViewRequest:
+        """Schedule a request (or answer it from the result cache)."""
+        version, _ = self.registry.current()
+        key = req.content_key(version)
+        hit = self.result_cache.get(key)
+        if hit is not None:
+            req.cached = True
+            req.submit_time = req.done_time = time.monotonic()
+            req._resolve(hit)
+            return req
+        self._submitted.inc()
+        return self.scheduler.submit(req)
+
+    def start(self) -> "Engine":
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="diff3d-serving-engine",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self.scheduler.close(reject_pending=True)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def snapshot_extra(self) -> dict:
+        """Engine-level details merged into the metrics snapshot."""
+        return {
+            "engine": {
+                "alive": self.alive,
+                "params_version": self.registry.version,
+                "step_timer": self.step_timer.summary(),
+                "program_cache": self.programs.stats(),
+                "result_cache_entries": len(self.result_cache),
+            }
+        }
+
+    # -- executor loop ---------------------------------------------------
+
+    def _loop(self) -> None:
+        active: List[_Slot] = []
+        try:
+            while not self._stop.is_set():
+                active = self._admit(active)
+                if not active:
+                    continue
+                try:
+                    self._run_view_step(active)
+                except Exception as e:   # resolve, don't kill the server
+                    log.exception("view step failed")
+                    for slot in active:
+                        self._failed.inc()
+                        slot.req._reject(e)
+                    active = []
+                    continue
+                active = self._retire(active)
+        finally:
+            for slot in active:
+                slot.req._reject(RuntimeError("engine stopped"))
+            self._active_g.set(0)
+
+    def _admit(self, active: List[_Slot]) -> List[_Slot]:
+        free = self.cfg.max_batch - len(active)
+        if active:
+            got = self.scheduler.acquire(active[0].req.bucket, free,
+                                         block=False) if free > 0 else []
+        else:
+            got = self.scheduler.acquire(None, self.cfg.max_batch,
+                                         block=True, poll_s=0.2)
+        now = time.monotonic()
+        for req in got:
+            self._queue_wait.observe(now - req.submit_time)
+            active.append(_Slot(req, self.guidance_B))
+        if got or not active:
+            self._active_g.set(len(active))
+        return active
+
+    def _run_view_step(self, active: List[_Slot]) -> None:
+        n = len(active)
+        lanes = _pow2_lanes(n, self.cfg.max_batch)
+        pad = lanes - n
+        # Pad by repeating lane 0 (live data: zero-filled lanes would
+        # still run the full scan, and denormals/NaN paths can be slower
+        # than real numbers).  Padded outputs are discarded.
+        idx = list(range(n)) + [0] * pad
+        record_imgs = np.stack([active[i].record_imgs for i in idx])
+        record_R = np.stack([active[i].record_R for i in idx])
+        record_T = np.stack([active[i].record_T for i in idx])
+        steps = np.asarray([active[i].step for i in idx], np.int32)
+        target_R = np.stack([active[i].req.R[active[i].step] for i in idx])
+        target_T = np.stack([active[i].req.T[active[i].step] for i in idx])
+        Ks = np.stack([active[i].req.K for i in idx])
+
+        # Per-request RNG stream: identical to the offline synthesize
+        # loop's `rng, k = jax.random.split(rng)` per view.
+        step_keys = []
+        for slot in active:
+            slot.rng, k = jax.random.split(slot.rng)
+            step_keys.append(k)
+        keys = jax.numpy.stack(step_keys
+                               + [step_keys[0]] * pad)
+
+        version, params = self.registry.current()
+        bucket = active[0].req.bucket
+        t0 = time.monotonic()
+        out = self.programs.step_many(
+            bucket, lanes, record_imgs, record_R, record_T, steps,
+            target_R, target_T, Ks, keys, params=params)
+        out = np.asarray(jax.block_until_ready(out))
+        dt = time.monotonic() - t0
+        self.step_timer.tick()
+        self._view_lat.observe(dt)
+        self._occupancy.observe(n)
+        self._padding.observe(pad / lanes if lanes else 0.0)
+        self._views_done.inc(n)
+
+        now = time.monotonic()
+        for i, slot in enumerate(active):
+            view = out[i]
+            slot.record_imgs[slot.step] = view
+            slot.record_R[slot.step] = slot.req.R[slot.step]
+            slot.record_T[slot.step] = slot.req.T[slot.step]
+            slot.outs.append(view)
+            if slot.req.first_view_time is None:
+                slot.req.first_view_time = now
+                self._ttfv.observe(now - slot.req.submit_time)
+            slot.step += 1
+        # One params version per launched batch; remember it for the
+        # result-cache key of requests that finish this step.
+        self._last_version = version
+
+    def _retire(self, active: List[_Slot]) -> List[_Slot]:
+        still: List[_Slot] = []
+        now = time.monotonic()
+        for slot in active:
+            req = slot.req
+            if req.cancelled:
+                self._failed.inc()
+                req._reject(RequestCancelled(f"{req.id}: cancelled"))
+            elif req.expired(now):
+                self._failed.inc()
+                req._reject(RequestTimeout(
+                    f"{req.id}: deadline exceeded mid-run at view "
+                    f"{slot.step - 1}/{req.n_views - 1}"))
+            elif slot.step >= req.n_views:
+                result = np.stack(slot.outs)
+                version = getattr(self, "_last_version",
+                                  self.registry.version)
+                self.result_cache.put(req.content_key(version), result)
+                self._completed.inc()
+                self._e2e.observe(now - req.submit_time)
+                req._resolve(result)
+            else:
+                still.append(slot)
+        if len(still) != len(active):
+            self._active_g.set(len(still))
+        return still

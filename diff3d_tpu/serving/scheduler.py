@@ -1,0 +1,362 @@
+"""Request queue + microbatcher for the inference service.
+
+Requests are grouped into **shape buckets** ``(H, W, record capacity)`` —
+the tuple that determines the compiled program for a view step (the batch
+lane count is handled by the engine's power-of-two padding).  Capacity
+comes from :func:`diff3d_tpu.sampling.record_capacity`, so a served
+request lands on exactly the program shape the offline sampler would
+compile for the same view count.
+
+Scheduling policy (Orca-style iteration-level scheduling, adapted to
+fixed-length diffusion scans):
+  * the engine asks for work *between view steps*, so a long 20-view job
+    never blocks a 1-view job for more than one view's worth of compute;
+  * an idle engine blocks until a request arrives, then waits at most
+    ``max_wait`` (measured from the oldest pending request's submit time)
+    for co-batchable requests before launching underfull;
+  * the queue is **bounded**: submissions beyond ``max_queue`` raise
+    :class:`QueueFullError` immediately (explicit backpressure, HTTP 429),
+    and every request carries a deadline after which it is resolved with
+    :class:`RequestTimeout` instead of silently rotting in the queue.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from diff3d_tpu.sampling import record_capacity
+
+
+class Bucket(NamedTuple):
+    """Shape key of a compiled view-step program (minus the lane count)."""
+
+    H: int
+    W: int
+    capacity: int
+
+
+class QueueFullError(RuntimeError):
+    """Bounded queue is full — request rejected at submit time."""
+
+
+class RequestTimeout(RuntimeError):
+    """Request deadline expired before (or while) running."""
+
+
+class RequestCancelled(RuntimeError):
+    """Request was cancelled by the client before completion."""
+
+
+_req_ids = itertools.count()
+
+
+class ViewRequest:
+    """One novel-view synthesis job: autoregressively generate views
+    ``1..n_views-1`` of an object from its view-0 image and the target
+    poses, with the per-request RNG stream of
+    ``Sampler.synthesize(views, PRNGKey(seed))`` (same seed => bit-equal
+    result on the same backend).
+
+    ``views`` is the ``all_views``-style dict: ``imgs [>=1, H, W, 3]``
+    (only view 0 is consumed), ``R [n, 3, 3]``, ``T [n, 3]``,
+    ``K [3, 3]``.
+    """
+
+    def __init__(self, views: dict, seed: int = 0,
+                 n_views: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
+                 request_id: Optional[str] = None):
+        imgs = np.asarray(views["imgs"], np.float32)
+        R = np.asarray(views["R"], np.float32)
+        T = np.asarray(views["T"], np.float32)
+        K = np.asarray(views["K"], np.float32)
+        if imgs.ndim != 4 or imgs.shape[-1] != 3:
+            raise ValueError(f"imgs must be [n, H, W, 3], got {imgs.shape}")
+        if R.ndim != 3 or R.shape[-2:] != (3, 3):
+            raise ValueError(f"R must be [n, 3, 3], got {R.shape}")
+        if T.ndim != 2 or T.shape[-1] != 3:
+            raise ValueError(f"T must be [n, 3], got {T.shape}")
+        if K.shape != (3, 3):
+            raise ValueError(f"K must be [3, 3], got {K.shape}")
+        if R.shape[0] != T.shape[0]:
+            raise ValueError(
+                f"R/T view counts differ: {R.shape[0]} vs {T.shape[0]}")
+        avail = R.shape[0]
+        self.n_views = avail if n_views is None else min(int(n_views),
+                                                         avail)
+        if self.n_views < 2:
+            raise ValueError(
+                f"n_views={self.n_views}: need >= 2 (view 0 conditions, "
+                "views 1.. are synthesised)")
+        self.imgs0 = imgs[0]
+        self.R = R[:self.n_views]
+        self.T = T[:self.n_views]
+        self.K = K
+        self.seed = int(seed)
+        self.timeout_s = timeout_s
+        H, W = imgs.shape[1:3]
+        self.bucket = Bucket(H, W, record_capacity(self.n_views))
+        self.id = request_id or f"req-{next(_req_ids)}"
+
+        self.submit_time: Optional[float] = None
+        self.deadline: Optional[float] = None
+        self.first_view_time: Optional[float] = None
+        self.done_time: Optional[float] = None
+        self.cached = False
+
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+
+    # -- result plumbing ------------------------------------------------
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for the result ``[n_views-1, B, H, W, 3]``; raises the
+        request's error (:class:`RequestTimeout`, ...) if it failed."""
+        if not self._event.wait(timeout):
+            raise RequestTimeout(
+                f"{self.id}: no result within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _resolve(self, result: np.ndarray) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._result = result
+            self.done_time = time.monotonic()
+            self._event.set()
+
+    def _reject(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._error = exc
+            self.done_time = time.monotonic()
+            self._event.set()
+
+    def cancel(self) -> bool:
+        """Best-effort cancel; returns False once the request finished.
+        A request already admitted to the engine finishes its in-flight
+        view step, then is dropped before the next one."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._cancelled = True
+        return True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) > self.deadline
+
+    def content_key(self, params_version: str, extra: str = "") -> str:
+        """Content hash for the result cache: identical inputs + seed +
+        params version => identical output (the sampler is deterministic
+        given the key), so replays can skip the chip entirely."""
+        h = hashlib.sha256()
+        for a in (self.imgs0, self.R, self.T, self.K):
+            h.update(np.ascontiguousarray(a).tobytes())
+        h.update(f"|{self.seed}|{self.n_views}|{params_version}|{extra}"
+                 .encode())
+        return h.hexdigest()
+
+
+class Scheduler:
+    """Bounded, bucketed FIFO with deadline sweeping.
+
+    The engine is the single consumer; producers are HTTP handler
+    threads calling :meth:`submit`.
+    """
+
+    def __init__(self, max_queue: int = 64, max_wait_s: float = 0.05,
+                 default_timeout_s: float = 300.0, metrics=None):
+        self.max_queue = max_queue
+        self.max_wait_s = max_wait_s
+        self.default_timeout_s = default_timeout_s
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._pending: "OrderedDict[Bucket, Deque[ViewRequest]]" = \
+            OrderedDict()
+        self._closed = False
+        m = metrics
+        self._depth_gauge = m.gauge(
+            "serving_queue_depth",
+            "requests waiting for admission") if m else None
+        self._timeouts = m.counter(
+            "serving_requests_timeout_total",
+            "requests expired before completion") if m else None
+        self._rejects = m.counter(
+            "serving_requests_rejected_total",
+            "submissions rejected by the bounded queue") if m else None
+
+    # -- producer side --------------------------------------------------
+
+    def submit(self, req: ViewRequest) -> ViewRequest:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            if self._depth_locked() >= self.max_queue:
+                if self._rejects:
+                    self._rejects.inc()
+                raise QueueFullError(
+                    f"queue full ({self.max_queue} pending): retry later")
+            now = time.monotonic()
+            req.submit_time = now
+            timeout = (self.default_timeout_s if req.timeout_s is None
+                       else req.timeout_s)
+            req.deadline = now + timeout
+            self._pending.setdefault(req.bucket, deque()).append(req)
+            self._update_depth()
+            self._nonempty.notify_all()
+        return req
+
+    # -- consumer (engine) side -----------------------------------------
+
+    def acquire(self, bucket: Optional[Bucket], max_n: int,
+                block: bool = True,
+                poll_s: float = 0.2) -> List[ViewRequest]:
+        """Take up to ``max_n`` runnable requests.
+
+        ``bucket`` given (engine already has active work of that shape):
+        non-blocking grab of co-batchable requests — continuous batching
+        admits them at the next view boundary.
+
+        ``bucket`` None (engine idle): block until any request is pending
+        (up to ``poll_s``, so the engine can re-check shutdown), pick the
+        bucket of the *oldest* pending request, then hold until that
+        request has aged ``max_wait_s`` (the microbatch flush deadline)
+        or ``max_n`` co-batchable requests are available.
+        """
+        with self._lock:
+            self._sweep_locked()
+            if bucket is not None:
+                got = self._take_locked(bucket, max_n)
+                self._update_depth()
+                return got
+            if not block:
+                b = self._oldest_bucket_locked()
+                got = self._take_locked(b, max_n) if b else []
+                self._update_depth()
+                return got
+
+            deadline = time.monotonic() + poll_s
+            while not self._closed:
+                self._sweep_locked()
+                b = self._oldest_bucket_locked()
+                if b is not None:
+                    head = self._pending[b][0]
+                    flush_at = head.submit_time + self.max_wait_s
+                    while (len(self._pending.get(b) or ()) < max_n
+                           and time.monotonic() < flush_at
+                           and not self._closed):
+                        self._nonempty.wait(
+                            max(0.0, flush_at - time.monotonic()))
+                        self._sweep_locked()
+                        # The head may have expired during the wait; fall
+                        # back to whatever is oldest now.
+                        nb = self._oldest_bucket_locked()
+                        if nb is None:
+                            break
+                        if nb != b:
+                            b = nb
+                            flush_at = (self._pending[b][0].submit_time
+                                        + self.max_wait_s)
+                    got = self._take_locked(b, max_n)
+                    if got:
+                        self._update_depth()
+                        return got
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._nonempty.wait(remaining)
+            self._update_depth()
+            return []
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth_locked()
+
+    def close(self, reject_pending: bool = True) -> None:
+        """Stop accepting work; optionally reject everything queued."""
+        with self._lock:
+            self._closed = True
+            if reject_pending:
+                for q in self._pending.values():
+                    for req in q:
+                        req._reject(RuntimeError("server shutting down"))
+                self._pending.clear()
+            self._update_depth()
+            self._nonempty.notify_all()
+
+    # -- internals (lock held) ------------------------------------------
+
+    def _depth_locked(self) -> int:
+        return sum(len(q) for q in self._pending.values())
+
+    def _update_depth(self) -> None:
+        if self._depth_gauge:
+            self._depth_gauge.set(self._depth_locked())
+
+    def _sweep_locked(self) -> None:
+        """Resolve expired / drop cancelled requests in place."""
+        now = time.monotonic()
+        for b in list(self._pending):
+            q = self._pending[b]
+            kept: Deque[ViewRequest] = deque()
+            for req in q:
+                if req.cancelled:
+                    req._reject(RequestCancelled(f"{req.id}: cancelled"))
+                elif req.expired(now):
+                    if self._timeouts:
+                        self._timeouts.inc()
+                    req._reject(RequestTimeout(
+                        f"{req.id}: deadline exceeded after "
+                        f"{now - req.submit_time:.2f}s in queue"))
+                else:
+                    kept.append(req)
+            if kept:
+                self._pending[b] = kept
+            else:
+                del self._pending[b]
+
+    def _oldest_bucket_locked(self) -> Optional[Bucket]:
+        best, best_t = None, None
+        for b, q in self._pending.items():
+            if q and (best_t is None or q[0].submit_time < best_t):
+                best, best_t = b, q[0].submit_time
+        return best
+
+    def _take_locked(self, bucket: Optional[Bucket],
+                     max_n: int) -> List[ViewRequest]:
+        if bucket is None or bucket not in self._pending or max_n <= 0:
+            return []
+        q = self._pending[bucket]
+        got = []
+        while q and len(got) < max_n:
+            got.append(q.popleft())
+        if not q:
+            del self._pending[bucket]
+        return got

@@ -1,0 +1,261 @@
+"""Stdlib HTTP frontend for the inference service.
+
+``ThreadingHTTPServer`` + ``BaseHTTPRequestHandler`` only — the service
+has to run in the bare jax_graft container, so no web framework.  Handler
+threads do pure host work (JSON <-> numpy, queue submit, event wait); the
+single engine thread owns every device call, so ``GET /healthz`` and
+``GET /metrics`` stay responsive while a multi-minute job is on the chip.
+
+Surface:
+  * ``POST /synthesize`` — submit a job.  Body: ``{"views": {"imgs",
+    "R", "T", "K"}, "seed": 0, "n_views"?: int, "timeout_s"?: float,
+    "block"?: bool}``.  ``block=true`` (default) waits for the result;
+    ``block=false`` returns ``202 {"id"}`` for later polling.
+  * ``GET /result/<id>`` — poll a submitted job.
+  * ``GET /healthz`` — liveness + engine/queue state.
+  * ``GET /metrics`` — text exposition; ``/metrics?format=json`` for the
+    structured snapshot.
+
+Backpressure maps to status codes, never to silent queuing: a full queue
+is ``429``, a request deadline is ``504``, a cancelled request ``409``,
+malformed input ``400``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from diff3d_tpu.config import Config
+from diff3d_tpu.serving.cache import ParamsRegistry, ProgramCache, ResultCache
+from diff3d_tpu.serving.engine import Engine
+from diff3d_tpu.serving.metrics import MetricsRegistry
+from diff3d_tpu.serving.scheduler import (QueueFullError, RequestCancelled,
+                                          RequestTimeout, Scheduler,
+                                          ViewRequest)
+
+log = logging.getLogger(__name__)
+
+
+def _error_status(exc: BaseException) -> int:
+    if isinstance(exc, QueueFullError):
+        return 429
+    if isinstance(exc, RequestTimeout):
+        return 504
+    if isinstance(exc, RequestCancelled):
+        return 409
+    if isinstance(exc, (ValueError, KeyError, TypeError)):
+        return 400
+    return 500
+
+
+class ServingService:
+    """Wires scheduler + engine + caches + metrics around one Sampler.
+
+    The HTTP layer is optional: tests and the serving bench drive
+    :meth:`submit` in-process.
+    """
+
+    def __init__(self, sampler, cfg: Config, params_version: str = "v0"):
+        cfg.serving.validate()
+        self.cfg = cfg
+        self.metrics = MetricsRegistry()
+        self.scheduler = Scheduler(
+            max_queue=cfg.serving.max_queue,
+            max_wait_s=cfg.serving.max_wait_ms / 1e3,
+            default_timeout_s=cfg.serving.default_timeout_s,
+            metrics=self.metrics)
+        self.registry = ParamsRegistry(sampler.params,
+                                       version=params_version)
+        self.engine = Engine(
+            sampler, self.scheduler, self.metrics, cfg.serving,
+            params_registry=self.registry,
+            result_cache=ResultCache(cfg.serving.result_cache_entries,
+                                     self.metrics),
+            program_cache=ProgramCache(sampler, self.metrics))
+        self._requests_lock = threading.Lock()
+        self._requests: "OrderedDict[str, ViewRequest]" = OrderedDict()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self, serve_http: bool = True) -> "ServingService":
+        self.engine.start()
+        if serve_http:
+            self._httpd = make_http_server(self, self.cfg.serving.host,
+                                           self.cfg.serving.port)
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="diff3d-serving-http", daemon=True)
+            self._http_thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.engine.stop()
+
+    @property
+    def port(self) -> Optional[int]:
+        """Bound port (useful with ``port=0`` for tests)."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    # -- request surface -------------------------------------------------
+
+    def submit(self, payload: dict) -> ViewRequest:
+        """Build + schedule a request from a JSON-shaped payload."""
+        if "views" not in payload:
+            raise ValueError("payload must carry a 'views' object with "
+                             "imgs/R/T/K")
+        n_views = payload.get("n_views")
+        if n_views is not None:
+            n_views = int(n_views)
+            if n_views > self.cfg.serving.max_views:
+                raise ValueError(
+                    f"n_views={n_views} exceeds the service ceiling "
+                    f"{self.cfg.serving.max_views}")
+        req = ViewRequest(
+            {k: np.asarray(v) for k, v in payload["views"].items()},
+            seed=int(payload.get("seed", 0)),
+            n_views=n_views,
+            timeout_s=payload.get("timeout_s"))
+        if req.n_views > self.cfg.serving.max_views:
+            raise ValueError(
+                f"request spans {req.n_views} views, service ceiling is "
+                f"{self.cfg.serving.max_views} (pass n_views to truncate)")
+        H, W = req.bucket.H, req.bucket.W
+        if (H, W) != (self.cfg.model.H, self.cfg.model.W):
+            raise ValueError(
+                f"image size {H}x{W} does not match the served model "
+                f"({self.cfg.model.H}x{self.cfg.model.W})")
+        self.engine.submit(req)
+        with self._requests_lock:
+            self._requests[req.id] = req
+            # Bound the id->request map: drop oldest *finished* entries.
+            while len(self._requests) > 4 * self.cfg.serving.max_queue:
+                oldest = next(iter(self._requests))
+                if not self._requests[oldest].done():
+                    break
+                del self._requests[oldest]
+        return req
+
+    def get_request(self, request_id: str) -> Optional[ViewRequest]:
+        with self._requests_lock:
+            return self._requests.get(request_id)
+
+    def result_payload(self, req: ViewRequest) -> dict:
+        out = req.result(timeout=0)
+        return {
+            "id": req.id,
+            "status": "done",
+            "cached": req.cached,
+            "n_views": req.n_views,
+            "shape": list(out.shape),
+            "views": out.tolist(),
+        }
+
+    def health(self) -> dict:
+        ok = self.engine.alive
+        return {
+            "status": "ok" if ok else "degraded",
+            "engine_alive": ok,
+            "queue_depth": self.scheduler.depth(),
+            "params_version": self.registry.version,
+        }
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot(extra=self.engine.snapshot_extra())
+
+
+def make_http_server(service: ServingService, host: str,
+                     port: int) -> ThreadingHTTPServer:
+    """Build (without starting) the HTTP server bound to ``host:port``."""
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "diff3d-serve/1.0"
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):   # route through logging, not
+            log.debug("%s " + fmt, self.address_string(), *args)  # stderr
+
+        def _send_json(self, status: int, obj: dict) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_text(self, status: int, text: str,
+                       ctype: str = "text/plain; version=0.0.4") -> None:
+            body = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            if url.path == "/healthz":
+                h = service.health()
+                self._send_json(200 if h["status"] == "ok" else 503, h)
+            elif url.path == "/metrics":
+                if "format=json" in (url.query or ""):
+                    self._send_json(200, service.metrics_snapshot())
+                else:
+                    self._send_text(200, service.metrics.exposition())
+            elif url.path.startswith("/result/"):
+                req = service.get_request(url.path[len("/result/"):])
+                if req is None:
+                    self._send_json(404, {"error": "unknown request id"})
+                elif not req.done():
+                    self._send_json(202, {"id": req.id,
+                                          "status": "pending"})
+                elif req.error is not None:
+                    self._send_json(_error_status(req.error),
+                                    {"id": req.id,
+                                     "error": str(req.error)})
+                else:
+                    self._send_json(200, service.result_payload(req))
+            else:
+                self._send_json(404, {"error": f"no route {url.path}"})
+
+        def do_POST(self):
+            url = urlparse(self.path)
+            if url.path != "/synthesize":
+                self._send_json(404, {"error": f"no route {url.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                req = service.submit(payload)
+            except Exception as e:
+                self._send_json(_error_status(e), {"error": str(e)})
+                return
+            if not payload.get("block", True):
+                self._send_json(202, {"id": req.id, "status": "pending"})
+                return
+            # Block the handler thread (not the engine) for the result.
+            wait = payload.get("timeout_s",
+                               service.cfg.serving.default_timeout_s)
+            try:
+                req.result(timeout=float(wait) + 5.0)
+                self._send_json(200, service.result_payload(req))
+            except Exception as e:
+                self._send_json(_error_status(e),
+                                {"id": req.id, "error": str(e)})
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True
+    return httpd

@@ -37,6 +37,36 @@ def test_acquire_backend_exhausts_and_raises(monkeypatch):
         bench._acquire_backend(attempts=2, wait_s=0.01)
 
 
+def test_acquire_backend_fails_fast_on_dial_hang(monkeypatch):
+    """A HANGING dial (BackendDialTimeout) must not be retried: each
+    attempt burns the full 180s budget and the r01–r05 records show the
+    harness rc=124-killing the process mid-backoff, leaving no JSON."""
+    calls = {"n": 0}
+
+    def hangs():
+        calls["n"] += 1
+        raise bench.BackendDialTimeout("backend dial exceeded 180s")
+
+    monkeypatch.setattr(jax, "devices", hangs)
+    with pytest.raises(bench.BackendDialTimeout):
+        bench._acquire_backend(attempts=6, wait_s=10.0)
+    assert calls["n"] == 1          # no retry, no 75s sleeps
+
+
+def test_main_emits_backend_dial_timeout_record(monkeypatch, capsys):
+    import json
+
+    monkeypatch.setattr(
+        bench, "_acquire_backend",
+        lambda: (_ for _ in ()).throw(
+            bench.BackendDialTimeout("backend dial exceeded 180s")))
+    assert bench.main() == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)          # MUST parse
+    assert rec["error"] == "backend-dial-timeout"
+    assert rec["value"] is None and "180s" in rec["detail"]
+
+
 def test_main_emits_parseable_json_when_backend_never_comes_up(
         monkeypatch, capsys):
     import json

@@ -1,0 +1,459 @@
+"""Serving layer: scheduler/microbatcher units, metrics, caches, and an
+end-to-end HTTP service on the CPU backend with the tiny config.
+
+The load-bearing guarantee: a request served through the whole stack
+(HTTP -> scheduler -> continuous-batched engine -> step_many) is
+BIT-identical to ``Sampler.synthesize`` with the same per-request rng —
+the engine replays the offline loop's exact key-split stream, and on a
+fixed backend the object-batched program matches the single-object one
+bitwise (pinned here; cross-backend it would be float-tolerance only).
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from diff3d_tpu.config import ServingConfig
+from diff3d_tpu.config import test_config as make_tiny_config
+from diff3d_tpu.data import SyntheticDataset
+from diff3d_tpu.models import XUNet
+from diff3d_tpu.sampling import Sampler, record_capacity
+from diff3d_tpu.serving import (Bucket, MetricsRegistry, ParamsRegistry,
+                                QueueFullError, RequestTimeout, ResultCache,
+                                Scheduler, ServingService, ViewRequest,
+                                make_http_server)
+from diff3d_tpu.train.trainer import init_params
+
+
+def _views_dict(ds, i):
+    v = ds.all_views(i)
+    return {"imgs": np.asarray(v["imgs"]), "R": np.asarray(v["R"]),
+            "T": np.asarray(v["T"]), "K": np.asarray(v["K"])}
+
+
+def _mk_request(ds, i, n_views=3, seed=0, timeout_s=None):
+    return ViewRequest(_views_dict(ds, i), seed=seed, n_views=n_views,
+                       timeout_s=timeout_s)
+
+
+@pytest.fixture(scope="module")
+def tiny_ds():
+    return SyntheticDataset(num_objects=4, num_views=6, imgsize=8)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler / microbatcher units (no device work)
+# ---------------------------------------------------------------------------
+
+
+def test_request_validation_and_bucketing(tiny_ds):
+    r3 = _mk_request(tiny_ds, 0, n_views=3)
+    r5 = _mk_request(tiny_ds, 1, n_views=5)
+    # capacity rounds to powers of two — 3 views -> 4, 5 views -> 8
+    assert r3.bucket == Bucket(8, 8, 4)
+    assert r5.bucket == Bucket(8, 8, 8)
+    assert r3.bucket.capacity == record_capacity(3)
+    with pytest.raises(ValueError):
+        _mk_request(tiny_ds, 0, n_views=1)      # nothing to synthesise
+    bad = _views_dict(tiny_ds, 0)
+    bad["K"] = np.zeros((2, 2))
+    with pytest.raises(ValueError):
+        ViewRequest(bad)
+
+
+def test_scheduler_groups_by_bucket(tiny_ds):
+    s = Scheduler(max_queue=8, max_wait_s=0.0)
+    a = s.submit(_mk_request(tiny_ds, 0, n_views=3))
+    b = s.submit(_mk_request(tiny_ds, 1, n_views=5))
+    c = s.submit(_mk_request(tiny_ds, 2, n_views=3))
+    got = s.acquire(a.bucket, max_n=8, block=False)
+    assert [r.id for r in got] == [a.id, c.id]   # same bucket, FIFO
+    assert s.depth() == 1
+    got2 = s.acquire(None, max_n=8, block=True, poll_s=0.5)
+    assert [r.id for r in got2] == [b.id]
+    assert s.depth() == 0
+
+
+def test_scheduler_max_wait_flushes_underfull_batch(tiny_ds):
+    s = Scheduler(max_queue=8, max_wait_s=0.15)
+    s.submit(_mk_request(tiny_ds, 0))
+    t0 = time.monotonic()
+    got = s.acquire(None, max_n=4, block=True, poll_s=5.0)
+    waited = time.monotonic() - t0
+    assert len(got) == 1
+    # held for the flush deadline (minus epsilon), not the full poll
+    assert 0.1 <= waited < 3.0
+
+
+def test_scheduler_full_batch_skips_the_wait(tiny_ds):
+    s = Scheduler(max_queue=8, max_wait_s=5.0)
+    for i in range(3):
+        s.submit(_mk_request(tiny_ds, i))
+    t0 = time.monotonic()
+    got = s.acquire(None, max_n=3, block=True, poll_s=10.0)
+    assert len(got) == 3
+    assert time.monotonic() - t0 < 1.0           # no 5s flush wait
+
+
+def test_scheduler_bounded_queue_rejects(tiny_ds):
+    m = MetricsRegistry()
+    s = Scheduler(max_queue=2, max_wait_s=0.0, metrics=m)
+    s.submit(_mk_request(tiny_ds, 0))
+    s.submit(_mk_request(tiny_ds, 1))
+    with pytest.raises(QueueFullError):
+        s.submit(_mk_request(tiny_ds, 2))
+    assert m.snapshot()["counters"][
+        "serving_requests_rejected_total"] == 1
+
+
+def test_scheduler_request_timeout_swept(tiny_ds):
+    m = MetricsRegistry()
+    s = Scheduler(max_queue=8, max_wait_s=0.0, metrics=m)
+    req = s.submit(_mk_request(tiny_ds, 0, timeout_s=0.01))
+    time.sleep(0.05)
+    assert s.acquire(req.bucket, max_n=4, block=False) == []
+    assert req.done()
+    with pytest.raises(RequestTimeout):
+        req.result(timeout=0)
+    assert m.snapshot()["counters"]["serving_requests_timeout_total"] == 1
+
+
+def test_request_cancellation(tiny_ds):
+    s = Scheduler(max_queue=8, max_wait_s=0.0)
+    req = s.submit(_mk_request(tiny_ds, 0))
+    assert req.cancel()
+    assert s.acquire(req.bucket, max_n=4, block=False) == []
+    assert req.done() and req.error is not None
+    assert not req.cancel()                      # already resolved
+
+
+# ---------------------------------------------------------------------------
+# Metrics / caches units
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_snapshot_and_exposition():
+    m = MetricsRegistry()
+    m.counter("c_total", "a counter").inc(3)
+    m.gauge("g", "a gauge").set(7)
+    h = m.histogram("h_seconds", "a histogram")
+    for v in range(1, 101):
+        h.observe(v / 100.0)
+    snap = m.snapshot()
+    assert snap["counters"]["c_total"] == 3
+    assert snap["gauges"]["g"] == 7
+    hs = snap["histograms"]["h_seconds"]
+    assert hs["count"] == 100
+    assert 0.45 <= hs["p50"] <= 0.55 and hs["p99"] >= 0.95
+    text = m.exposition()
+    assert "# TYPE c_total counter" in text
+    assert 'h_seconds{quantile="p50"}' in text
+    assert "h_seconds_count 100" in text
+    json.dumps(snap)                             # JSON-able
+
+
+def test_result_cache_lru_eviction():
+    c = ResultCache(capacity=2)
+    c.put("a", np.zeros(1)); c.put("b", np.ones(1))
+    assert c.get("a") is not None                # refresh 'a'
+    c.put("c", np.ones(1))                       # evicts 'b' (LRU)
+    assert c.get("b") is None
+    assert c.get("a") is not None and c.get("c") is not None
+    assert len(c) == 2
+
+
+def test_result_cache_key_sensitivity(tiny_ds):
+    r = _mk_request(tiny_ds, 0, seed=1)
+    assert r.content_key("v0") == r.content_key("v0")
+    assert r.content_key("v0") != r.content_key("v1")   # params version
+    r2 = _mk_request(tiny_ds, 0, seed=2)
+    assert r.content_key("v0") != r2.content_key("v0")  # rng seed
+
+
+def test_params_registry_guards_shape(setup_service):
+    _, _, params, *_ = setup_service
+    reg = ParamsRegistry(params, version="v0")
+    v = reg.swap(params)                         # same tree ok
+    assert v == "v1" and reg.version == "v1"
+    bad = jax.tree.map(lambda x: np.zeros(x.shape + (1,), x.dtype), params)
+    with pytest.raises(ValueError):
+        reg.swap(bad)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: HTTP service on the CPU backend, tiny config
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def setup_service(tiny_ds):
+    cfg = make_tiny_config(imgsize=8, ch=8)
+    cfg = dataclasses.replace(
+        cfg, serving=ServingConfig(port=0, max_batch=4, max_queue=8,
+                                   max_wait_ms=400.0, max_views=6,
+                                   default_timeout_s=120.0))
+    model = XUNet(cfg.model)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    sampler = Sampler(model, params, cfg)
+    service = ServingService(sampler, cfg).start(serve_http=True)
+    yield cfg, model, params, sampler, service, tiny_ds
+    service.stop()
+
+
+def _post(port, payload, timeout=300):
+    data = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/synthesize", data=data,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _payload(ds, i, n_views=3, seed=0, **kw):
+    v = _views_dict(ds, i)
+    return {"views": {k: a.tolist() for k, a in v.items()},
+            "seed": seed, "n_views": n_views, **kw}
+
+
+def test_http_concurrent_requests_bit_identical_and_batched(setup_service):
+    """The acceptance pin: N concurrent HTTP requests come back
+    bit-identical to the direct Sampler path, are co-batched (occupancy
+    > 1), and /healthz + /metrics answer while the job is in flight."""
+    cfg, model, params, sampler, service, ds = setup_service
+    port = service.port
+    results, errs = {}, []
+
+    def worker(i):
+        try:
+            status, body = _post(port, _payload(ds, i, seed=100 + i))
+            assert status == 200
+            results[i] = body
+        except Exception as e:                   # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    # Liveness while the engine is busy: both endpoints answer now.
+    status, body = _get(port, "/healthz")
+    assert status == 200 and json.loads(body)["engine_alive"]
+    status, body = _get(port, "/metrics")
+    assert status == 200 and b"serving_queue_depth" in body
+    for t in threads:
+        t.join()
+    assert not errs
+
+    for i in range(3):
+        direct = sampler.synthesize(ds.all_views(i),
+                                    jax.random.PRNGKey(100 + i),
+                                    max_views=3)
+        got = np.asarray(results[i]["views"], np.float32)
+        assert results[i]["shape"] == list(direct.shape)
+        np.testing.assert_array_equal(got, direct)
+
+    snap = service.metrics_snapshot()
+    occ = snap["histograms"]["serving_batch_occupancy"]
+    assert occ["max"] > 1, f"requests were never co-batched: {occ}"
+    assert snap["counters"]["serving_views_completed_total"] >= 6
+    assert snap["histograms"]["serving_time_to_first_view_seconds"][
+        "count"] >= 3
+
+
+def test_http_continuous_batching_admits_mid_job(setup_service):
+    """A short job submitted while a long job is mid-flight must join at
+    the next view boundary (iteration-level scheduling), not wait for the
+    long job to finish."""
+    cfg, model, params, sampler, service, ds = setup_service
+    port = service.port
+    long_res = {}
+
+    def long_worker():
+        _, long_res["body"] = _post(port, _payload(ds, 0, n_views=5,
+                                                   seed=7))
+
+    t = threading.Thread(target=long_worker)
+    before = service.metrics_snapshot()["counters"][
+        "serving_views_completed_total"]
+    t.start()
+    # Wait until the long job has completed >= 1 view, then submit.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        done = service.metrics_snapshot()["counters"][
+            "serving_views_completed_total"]
+        if done > before:
+            break
+        time.sleep(0.02)
+    # Same bucket (n_views=5 -> capacity 8): admitted at the next view
+    # boundary, several views behind the long job.
+    status, short = _post(port, _payload(ds, 1, n_views=5, seed=8))
+    assert status == 200
+    t.join()
+    long_direct = sampler.synthesize(ds.all_views(0),
+                                     jax.random.PRNGKey(7), max_views=5)
+    short_direct = sampler.synthesize(ds.all_views(1),
+                                      jax.random.PRNGKey(8), max_views=5)
+    np.testing.assert_array_equal(
+        np.asarray(long_res["body"]["views"], np.float32), long_direct)
+    np.testing.assert_array_equal(
+        np.asarray(short["views"], np.float32), short_direct)
+    occ = service.metrics_snapshot()["histograms"][
+        "serving_batch_occupancy"]
+    assert occ["max"] > 1
+
+
+def test_http_result_cache_replay(setup_service):
+    cfg, model, params, sampler, service, ds = setup_service
+    port = service.port
+    p = _payload(ds, 2, seed=42)
+    s1, r1 = _post(port, p)
+    s2, r2 = _post(port, p)
+    assert s1 == s2 == 200
+    assert not r1["cached"] and r2["cached"]
+    np.testing.assert_array_equal(np.asarray(r1["views"]),
+                                  np.asarray(r2["views"]))
+    assert service.metrics_snapshot()["counters"][
+        "serving_result_cache_hits_total"] >= 1
+
+
+def test_http_request_timeout_is_explicit(setup_service):
+    cfg, model, params, sampler, service, ds = setup_service
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(service.port, _payload(ds, 3, seed=9, timeout_s=0.0))
+    assert ei.value.code == 504
+    body = json.loads(ei.value.read())
+    assert "deadline" in body["error"]
+
+
+def test_http_validation_errors(setup_service):
+    cfg, model, params, sampler, service, ds = setup_service
+    port = service.port
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, {"seed": 1})                 # no views
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, _payload(ds, 0, n_views=60))  # over max_views
+    assert ei.value.code == 400
+    status, _ = _get(port, "/metrics?format=json")
+    assert status == 200
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(port, "/result/nope")
+    assert ei.value.code == 404
+
+
+def test_http_poll_path(setup_service):
+    cfg, model, params, sampler, service, ds = setup_service
+    port = service.port
+    status, body = _post(port, _payload(ds, 1, seed=11, block=False))
+    assert status == 202 and body["status"] == "pending"
+    rid = body["id"]
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        status, raw = _get(port, f"/result/{rid}")
+        if status == 200:
+            break
+        assert status == 202
+        time.sleep(0.05)
+    out = json.loads(raw)
+    direct = sampler.synthesize(ds.all_views(1), jax.random.PRNGKey(11),
+                                max_views=3)
+    np.testing.assert_array_equal(np.asarray(out["views"], np.float32),
+                                  direct)
+
+
+def test_hot_params_swap_changes_output_without_recompile(setup_service):
+    cfg, model, params, sampler, service, ds = setup_service
+    port = service.port
+    p = _payload(ds, 3, seed=13)
+    _, base = _post(port, p)
+    compiles_before = service.metrics_snapshot()["counters"][
+        "serving_program_compiles_total"]
+
+    # A different random init is NOT enough here: the X-UNet's output
+    # conv is zero-initialised, so any fresh init predicts eps=0 and the
+    # sample is params-independent.  Perturb every leaf instead.
+    params2 = jax.tree.map(lambda x: x + np.asarray(0.05, x.dtype), params)
+    service.registry.swap(params2, version="ckpt-2")
+    try:
+        assert json.loads(_get(port, "/healthz")[1])[
+            "params_version"] == "ckpt-2"
+        _, swapped = _post(port, p)
+        # different weights -> different views; and a different cache key,
+        # so this was NOT a result-cache replay
+        assert not swapped["cached"]
+        assert not np.array_equal(np.asarray(base["views"]),
+                                  np.asarray(swapped["views"]))
+    finally:
+        service.registry.swap(params, version="v0")
+    compiles_after = service.metrics_snapshot()["counters"][
+        "serving_program_compiles_total"]
+    assert compiles_after == compiles_before, \
+        "hot swap must not recompile (params is a jit argument)"
+
+
+def test_queue_full_and_degraded_health_over_http(setup_service):
+    """Backpressure at the HTTP boundary: with the engine down and a
+    1-deep queue, the second submission gets 429 and /healthz reports
+    degraded — requests are never silently queued without bound."""
+    cfg, model, params, sampler, service, ds = setup_service
+    cfg2 = dataclasses.replace(
+        cfg, serving=dataclasses.replace(cfg.serving, max_queue=1))
+    stalled = ServingService(sampler, cfg2)      # engine NOT started
+    httpd = make_http_server(stalled, "127.0.0.1", 0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    port = httpd.server_address[1]
+    try:
+        status, _ = _post(port, _payload(ds, 0, block=False))
+        assert status == 202
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(port, _payload(ds, 1, block=False))
+        assert ei.value.code == 429
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "degraded"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        stalled.scheduler.close()
+
+
+@pytest.mark.slow
+def test_serving_soak_waves(setup_service):
+    """Soak: several waves of mixed-size jobs; everything completes,
+    bit-identical, and the queue drains to zero."""
+    cfg, model, params, sampler, service, ds = setup_service
+    port = service.port
+    jobs = [(i % 4, 2 + (i % 3), 200 + i) for i in range(12)]
+    results = {}
+
+    def worker(j, obj, n, seed):
+        _, results[j] = _post(port, _payload(ds, obj, n_views=n,
+                                             seed=seed))
+
+    threads = [threading.Thread(target=worker, args=(j, *job))
+               for j, job in enumerate(jobs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for j, (obj, n, seed) in enumerate(jobs):
+        direct = sampler.synthesize(ds.all_views(obj),
+                                    jax.random.PRNGKey(seed), max_views=n)
+        np.testing.assert_array_equal(
+            np.asarray(results[j]["views"], np.float32), direct)
+    assert service.scheduler.depth() == 0

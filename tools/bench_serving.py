@@ -1,0 +1,208 @@
+"""Offered-load sweep for the serving layer.
+
+Drives the in-process service (scheduler + engine, no HTTP overhead) with
+synthetic requests at a sweep of arrival rates and reports, per rate:
+
+  * throughput (synthesised views/s),
+  * end-to-end latency p50/p99,
+  * mean batch occupancy and padding fraction (how well the microbatcher
+    filled the device batch at that load).
+
+The interesting curve is occupancy vs. latency: at low offered load every
+request rides alone (occupancy 1, minimal latency); as load rises the
+microbatcher amortises the compiled scan across requests (occupancy ->
+max_batch) and throughput climbs at bounded latency cost until the queue
+saturates.  A fresh service per rate keeps the metrics windows clean.
+
+Usage (CPU smoke):
+    JAX_PLATFORMS=cpu python tools/bench_serving.py --config test \
+        --rates 2,8,32 --requests 12 --out runs/bench_serving.json
+
+On a real chip, use the model config the service will run
+(``--config srn64``) and rates around the measured per-view service time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _build_service(args):
+    import jax
+
+    from diff3d_tpu import config as config_lib
+    from diff3d_tpu.config import ServingConfig
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.sampling import Sampler
+    from diff3d_tpu.serving import ServingService
+    from diff3d_tpu.train.trainer import init_params
+
+    cfg = {"srn64": config_lib.srn64_config,
+           "srn128": config_lib.srn128_config,
+           "test": config_lib.test_config}[args.config]()
+    if args.steps:
+        cfg = dataclasses.replace(
+            cfg, diffusion=dataclasses.replace(cfg.diffusion,
+                                               timesteps=args.steps))
+    cfg = dataclasses.replace(cfg, serving=ServingConfig(
+        max_batch=args.max_batch, max_queue=args.max_queue,
+        max_wait_ms=args.max_wait_ms, default_timeout_s=args.timeout_s,
+        max_views=max(16, args.n_views),
+        result_cache_entries=0))     # load bench must not replay results
+    model = XUNet(cfg.model)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    sampler = Sampler(model, params, cfg)
+    return sampler, cfg
+
+
+def _synthetic_views(n_views: int, size: int, seed: int):
+    import numpy as np
+
+    r = np.random.RandomState(seed)
+    return {
+        "imgs": r.randn(n_views, size, size, 3).astype(np.float32),
+        "R": np.broadcast_to(np.eye(3, dtype=np.float32),
+                             (n_views, 3, 3)).copy(),
+        "T": r.randn(n_views, 3).astype(np.float32),
+        "K": np.array([[size * 1.2, 0, size / 2],
+                       [0, size * 1.2, size / 2],
+                       [0, 0, 1]], np.float32),
+    }
+
+
+def _run_rate(sampler, cfg, rate: float, args) -> dict:
+    import numpy as np
+
+    from diff3d_tpu.serving import ServingService
+
+    service = ServingService(sampler, cfg).start(serve_http=False)
+    views = [_synthetic_views(args.n_views, cfg.model.H, i)
+             for i in range(args.requests)]
+    # Warm the fullest lane count so rate 0's first request doesn't pay
+    # the compile (every rate would otherwise time one compile each).
+    from diff3d_tpu.sampling import record_capacity
+    bucket = (cfg.model.H, cfg.model.W, record_capacity(args.n_views))
+    for lanes in {1, min(cfg.serving.max_batch,
+                         1 << (args.requests - 1).bit_length()
+                         if args.requests else 1)}:
+        service.engine.programs.warmup(bucket, lanes, sampler.w.shape[0])
+
+    from diff3d_tpu.serving.scheduler import ViewRequest
+    reqs, latencies, errors = [], [], []
+    lock = threading.Lock()
+
+    def waiter(req):
+        try:
+            req.result(timeout=args.timeout_s + 30)
+            with lock:
+                latencies.append(req.done_time - req.submit_time)
+        except Exception as e:
+            with lock:
+                errors.append(str(e))
+
+    t0 = time.perf_counter()
+    waiters = []
+    for i in range(args.requests):
+        req = ViewRequest(views[i], seed=i, n_views=args.n_views)
+        try:
+            service.engine.submit(req)
+        except Exception as e:
+            errors.append(str(e))
+            continue
+        reqs.append(req)
+        w = threading.Thread(target=waiter, args=(req,), daemon=True)
+        w.start()
+        waiters.append(w)
+        if rate > 0:
+            time.sleep(1.0 / rate)
+    for w in waiters:
+        w.join()
+    wall = time.perf_counter() - t0
+    snap = service.metrics_snapshot()
+    service.stop()
+
+    lat = np.asarray(sorted(latencies)) if latencies else np.zeros(0)
+    views_done = snap["counters"].get("serving_views_completed_total", 0)
+    occ = snap["histograms"].get("serving_batch_occupancy", {})
+    padf = snap["histograms"].get("serving_batch_padding_fraction", {})
+    return {
+        "offered_rate_rps": rate,
+        "requests": args.requests,
+        "completed": len(latencies),
+        "errors": len(errors),
+        "error_sample": errors[:3],
+        "wall_s": round(wall, 3),
+        "views_per_sec": round(views_done / wall, 3) if wall else None,
+        "latency_p50_s": (round(float(np.percentile(lat, 50)), 3)
+                          if lat.size else None),
+        "latency_p99_s": (round(float(np.percentile(lat, 99)), 3)
+                          if lat.size else None),
+        "occupancy_mean": round(occ.get("mean", 0.0), 3),
+        "padding_fraction_mean": round(padf.get("mean", 0.0), 3),
+        "ttfv_p50_s": round(snap["histograms"].get(
+            "serving_time_to_first_view_seconds", {}).get("p50", 0.0), 3),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", choices=["srn64", "srn128", "test"],
+                   default="test")
+    p.add_argument("--rates", default="2,8,32",
+                   help="comma-separated offered loads in requests/s "
+                        "(0 = submit everything at once)")
+    p.add_argument("--requests", type=int, default=8,
+                   help="requests per rate point")
+    p.add_argument("--n_views", type=int, default=3,
+                   help="views per request (incl. the conditioning view)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="diffusion steps per view (test config: 4)")
+    p.add_argument("--max_batch", type=int, default=8)
+    p.add_argument("--max_queue", type=int, default=256)
+    p.add_argument("--max_wait_ms", type=float, default=50.0)
+    p.add_argument("--timeout_s", type=float, default=600.0)
+    p.add_argument("--out", default="runs/bench_serving.json")
+    args = p.parse_args(argv)
+
+    sampler, cfg = _build_service(args)
+    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    points = []
+    for rate in rates:
+        print(f"bench_serving: rate={rate} rps ...", file=sys.stderr)
+        pt = _run_rate(sampler, cfg, rate, args)
+        print(f"bench_serving:   -> {pt['views_per_sec']} views/s, "
+              f"p50={pt['latency_p50_s']}s p99={pt['latency_p99_s']}s "
+              f"occupancy={pt['occupancy_mean']}", file=sys.stderr)
+        points.append(pt)
+
+    import jax
+
+    record = {
+        "bench": "serving_offered_load",
+        "config": args.config,
+        "platform": jax.devices()[0].platform,
+        "num_devices": len(jax.devices()),
+        "diffusion_steps": cfg.diffusion.timesteps,
+        "n_views": args.n_views,
+        "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms,
+        "points": points,
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
