@@ -181,7 +181,7 @@ def _train_bench(configs, n_steps: int, config: str):
 
 
 def _sampler_bench(config: str = "srn64", n_views: int = 4,
-                   object_batch: int = 1):
+                   object_batch: int = 1, use_mesh: bool = False):
     """Seconds per synthesised view, reference sampler config (256 steps,
     8-weight guidance sweep, ``/root/reference/sampling.py:130-158``) —
     one compiled lax.scan per view.  ``srn128`` runs the full-resolution
@@ -192,12 +192,18 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     (``Sampler.synthesize_many``) — the configuration ``eval_cli`` ships
     with, where N independent objects share each compiled scan; reported
     cost is per *effective* synthesised view (total time / N*(n_views-1)).
+
+    ``use_mesh`` compiles the sampler with the config's device mesh
+    (object axis sharded over the data axis — the sharded serving/eval
+    runtime); ``object_batch`` should then be a multiple of the data-axis
+    size or padding lanes dilute the per-view number.
     """
     import jax
     import numpy as np
 
     from diff3d_tpu.config import srn64_config, srn128_config
     from diff3d_tpu.models import XUNet
+    from diff3d_tpu.parallel import make_mesh
     from diff3d_tpu.sampling.runtime import Sampler
     from diff3d_tpu.train.trainer import init_params
 
@@ -208,8 +214,9 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     # past the dev tunnel's RPC deadline — chunk it into 4 executions
     # (bit-identical result, test_sampling pins it; chunks=1 elsewhere).
     chunks = 4 if config == "srn128" else 1
+    mesh_env = make_mesh(cfg.mesh) if use_mesh else None
     sampler = Sampler(model, init_params(model, cfg, rng), cfg,
-                      scan_chunks=chunks)
+                      scan_chunks=chunks, mesh=mesh_env)
 
     s = cfg.model.H
 
@@ -404,9 +411,31 @@ def main() -> int:
                 "vs_baseline": None,   # reference published no timing
                 "raw_seconds": round(raw_s, 2),
                 "effective_views": n_eff,
+                "chips_used": 1,
             }
         except Exception as e:
             payload["sampler"] = {"error": str(e).splitlines()[0][:200]}
+        if ndev > 1 and isinstance(payload.get("sampler"), dict) \
+                and "value" in payload["sampler"]:
+            # Sharded runtime: one object per chip on the data axis.  The
+            # unsharded block above keeps its longitudinal metric name;
+            # per-chip scaling = value / sharded.sec_per_view.
+            try:
+                sh_spv, sh_raw, sh_eff = _sampler_bench(
+                    object_batch=ndev, use_mesh=True)
+                payload["sampler"]["sharded"] = {
+                    "chips_used": ndev,
+                    "sec_per_view": round(sh_spv, 2),
+                    "raw_seconds": round(sh_raw, 2),
+                    "effective_views": sh_eff,
+                    "object_batch": ndev,
+                    "speedup_vs_single": round(
+                        payload["sampler"]["value"] / sh_spv, 2)
+                    if sh_spv else None,
+                }
+            except Exception as e:
+                payload["sampler"]["sharded"] = {
+                    "error": str(e).splitlines()[0][:200]}
         try:
             # Object-batch 2, 2 views each = 2 effective synthesised views
             # per batched 256-step scan at 16384 tokens/frame, full-width
@@ -426,9 +455,28 @@ def main() -> int:
                 "vs_baseline": None,   # reference cannot run 128^2 at all
                 "raw_seconds": round(raw_s128, 2),
                 "effective_views": n_eff128,
+                "chips_used": 1,
             }
         except Exception as e:
             payload["sampler128"] = {"error": str(e).splitlines()[0][:200]}
+        if ndev > 1 and isinstance(payload.get("sampler128"), dict) \
+                and "value" in payload["sampler128"]:
+            try:
+                sh_spv, sh_raw, sh_eff = _sampler_bench(
+                    "srn128", n_views=2, object_batch=ndev, use_mesh=True)
+                payload["sampler128"]["sharded"] = {
+                    "chips_used": ndev,
+                    "sec_per_view": round(sh_spv, 2),
+                    "raw_seconds": round(sh_raw, 2),
+                    "effective_views": sh_eff,
+                    "object_batch": ndev,
+                    "speedup_vs_single": round(
+                        payload["sampler128"]["value"] / sh_spv, 2)
+                    if sh_spv else None,
+                }
+            except Exception as e:
+                payload["sampler128"]["sharded"] = {
+                    "error": str(e).splitlines()[0][:200]}
 
     print(json.dumps(payload))
     return 0

@@ -81,6 +81,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "1 to float tolerance).  Default: 8 at <=64^2, 2 "
                         "above (the batched model call and the record "
                         "buffer both scale with it; lower if OOM)")
+    p.add_argument("--mesh", action="store_true",
+                   help="shard synthesis over a device mesh (cfg.mesh): "
+                        "the object batch rides the data axis, params "
+                        "follow the configured replicated/fsdp policy; "
+                        "--object_batch rounds up to the data-axis size")
     add_model_width_args(p)
     p.add_argument("--picklefile", default=None)
     p.add_argument("--config", choices=["srn64", "srn128", "test"],
@@ -245,8 +250,16 @@ def main(argv=None) -> None:
                         imgsize=cfg.model.H,
                         split_seed=cfg.data.split_seed,
                         train_fraction=cfg.data.train_fraction)
+    mesh_env = None
+    if args.mesh:
+        from diff3d_tpu.parallel import make_mesh
+
+        mesh_env = make_mesh(cfg.mesh)
+        logging.info("sampling on mesh %s (object axis over '%s', "
+                     "params %s)", dict(mesh_env.mesh.shape),
+                     cfg.mesh.data_axis, cfg.mesh.param_sharding)
     sampler = Sampler(model, params, cfg,
-                      scan_chunks=args.scan_chunks)
+                      scan_chunks=args.scan_chunks, mesh=mesh_env)
 
     if args.object_batch is None:
         # The batched model call (N*2B examples) and the [N, capacity, B,
@@ -256,6 +269,13 @@ def main(argv=None) -> None:
         args.object_batch = 8 if cfg.model.H <= 64 else 2
         logging.info("object_batch auto -> %d (H=%d)", args.object_batch,
                      cfg.model.H)
+    if args.object_batch % sampler.lane_multiple:
+        # synthesize_many pads internally, but a non-multiple batch wastes
+        # the padding lanes' FLOPs every chunk — round the batch itself.
+        args.object_batch = (-(-args.object_batch // sampler.lane_multiple)
+                             * sampler.lane_multiple)
+        logging.info("object_batch rounded -> %d (mesh data-axis size %d)",
+                     args.object_batch, sampler.lane_multiple)
 
     ephemeral_resume_dir = None
     if args.resume_dir is None:
@@ -315,6 +335,11 @@ def main(argv=None) -> None:
         "seed": int(args.seed),
         "max_views": args.max_views,
         "H": int(cfg.model.H),
+        # The guidance sweep is the record's B axis: a changed sweep must
+        # invalidate stale records, or psnr_per_w / --w_index silently
+        # mis-index into generations made under different weights.
+        "guidance_weights": [float(w) for w in
+                             cfg.diffusion.guidance_weights],
     }
 
     # ---- Phase 1: synthesis (resumable; each object lands on disk the

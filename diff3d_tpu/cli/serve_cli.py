@@ -60,6 +60,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scan_chunks", type=int, default=1,
                    help="split each view's diffusion scan into this many "
                         "device executions (must divide --steps)")
+    p.add_argument("--mesh", action="store_true",
+                   help="shard serving over a device mesh (cfg.mesh): "
+                        "the request batch's object axis rides the data "
+                        "axis, params follow the configured "
+                        "replicated/fsdp policy; lane counts round up to "
+                        "the data-axis size")
     p.add_argument("--raw_params", action="store_true",
                    help="serve raw params instead of EMA")
     p.add_argument("--warmup", action="store_true",
@@ -118,12 +124,21 @@ def build_service(args):
         version = f"{args.model}@step{step}"
     logging.info("serving %s params (step %d)", version, step)
 
-    sampler = Sampler(model, params, cfg, scan_chunks=args.scan_chunks)
+    mesh_env = None
+    if getattr(args, "mesh", False):
+        from diff3d_tpu.parallel import make_mesh
+
+        mesh_env = make_mesh(cfg.mesh)
+        logging.info("serving on mesh %s (lane multiple %d)",
+                     dict(mesh_env.mesh.shape), mesh_env.data_size)
+    sampler = Sampler(model, params, cfg, scan_chunks=args.scan_chunks,
+                      mesh=mesh_env)
     service = ServingService(sampler, cfg, params_version=version)
     if args.warmup:
         bucket = (cfg.model.H, cfg.model.W,
                   record_capacity(cfg.serving.max_views))
-        secs = service.engine.programs.warmup(bucket, 1,
+        secs = service.engine.programs.warmup(bucket,
+                                              sampler.lane_multiple,
                                               sampler.w.shape[0])
         logging.info("warmed bucket %s in %.1fs", bucket, secs)
     return service

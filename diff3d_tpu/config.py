@@ -211,6 +211,10 @@ class ServingConfig:
     # Device-batch lane ceiling per bucket; the engine pads the active set
     # up to the next power of two <= max_batch (logarithmic number of
     # compiled programs per bucket, same trick as the record capacity).
+    # When the sampler rides a mesh, the engine additionally rounds lane
+    # counts — and this ceiling itself — UP to a multiple of the mesh's
+    # data-axis size (a sharded object axis must divide evenly; see
+    # serving/engine.py lane_count).
     max_batch: int = 8
     # Microbatcher flush deadline: after the first request of a bucket
     # arrives, wait at most this long for co-batchable requests before

@@ -8,6 +8,8 @@ from diff3d_tpu.diffusion.core import (
     sample_loop,
     sample_loop_prepare,
     sample_loop_scan,
+    sample_view,
+    sample_view_commit,
 )
 
 __all__ = [
@@ -20,4 +22,6 @@ __all__ = [
     "sample_loop",
     "sample_loop_prepare",
     "sample_loop_scan",
+    "sample_view",
+    "sample_view_commit",
 ]

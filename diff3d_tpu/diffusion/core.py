@@ -180,6 +180,58 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
     return state.img
 
 
+def sample_view(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
+                record_R: jnp.ndarray, record_T: jnp.ndarray,
+                record_len: jnp.ndarray, K: jnp.ndarray, w: jnp.ndarray,
+                rng: jax.Array, timesteps: int = 256,
+                logsnr_min: float = -20.0, logsnr_max: float = 20.0,
+                clip_x0: bool = True):
+    """One autoregressive view step over a DEVICE-RESIDENT record.
+
+    The record-carry contract (the sampler's host loop never touches the
+    buffers between views):
+
+      * ``record_R`` / ``record_T`` are pre-filled with the poses of ALL
+        views up front — safe because the stochastic-conditioning draw
+        (:func:`sample_loop_prepare`) only reads indices ``<
+        record_len``, so entry ``record_len`` doubles as the target pose
+        of the view being synthesised.
+      * the generated view is written back at index ``record_len`` via
+        ``lax.dynamic_update_slice`` (donate ``record_imgs`` when
+        jitting: the update is then in place on device).
+      * ``rng`` is the per-object carry; it is split here exactly like
+        the legacy host loop's ``rng, k = jax.random.split(rng)``, so
+        the per-view key stream is bit-identical to the pre-resident
+        sampler (the serving parity tests pin this).
+
+    Returns ``(out, record_imgs, record_len + 1, rng)`` with ``out``
+    ``[B, H, W, 3]`` — a pure carry update; the host feeds the returned
+    buffers straight into the next call.
+    """
+    rng, k = jax.random.split(rng)
+    out = sample_loop(
+        denoise_fn, record_imgs=record_imgs, record_R=record_R,
+        record_T=record_T, record_len=record_len,
+        target_R=record_R[record_len], target_T=record_T[record_len],
+        K=K, w=w, rng=k, timesteps=timesteps, logsnr_min=logsnr_min,
+        logsnr_max=logsnr_max, clip_x0=clip_x0)
+    out2, record_imgs, record_len = sample_view_commit(
+        record_imgs, record_len, out)
+    return out2, record_imgs, record_len, rng
+
+
+def sample_view_commit(record_imgs: jnp.ndarray, record_len: jnp.ndarray,
+                       img: jnp.ndarray):
+    """Append ``img`` to the record at index ``record_len`` (the
+    device-resident tail of :func:`sample_view`, split out so chunked
+    callers can commit after their last :func:`sample_loop_scan` chunk).
+    Returns ``(img, record_imgs, record_len + 1)``."""
+    start = (record_len,) + (0,) * (record_imgs.ndim - 1)
+    record_imgs = jax.lax.dynamic_update_slice(
+        record_imgs, img[None].astype(record_imgs.dtype), start)
+    return img, record_imgs, record_len + 1
+
+
 def sample_loop_prepare(*, record_len: jnp.ndarray, rng: jax.Array,
                         timesteps: int, shape, logsnr_min: float,
                         logsnr_max: float):

@@ -44,6 +44,13 @@ class MeshEnv:
     def data_axis(self) -> str:
         return self.cfg.data_axis
 
+    @property
+    def data_size(self) -> int:
+        """Number of devices on the data axis — the divisibility quantum
+        for any leading dim sharded with :meth:`batch` (the sampler's
+        object axis, the serving engine's lane counts)."""
+        return int(self.mesh.shape[self.cfg.data_axis])
+
     def batch(self) -> NamedSharding:
         return batch_sharding(self.mesh, self.cfg.data_axis)
 

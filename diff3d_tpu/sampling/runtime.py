@@ -15,15 +15,37 @@ TPU-native architecture (vs the reference's per-step host round-trips,
     device array indexed by pre-sampled stochastic-conditioning choices,
     and the CFG cond/uncond double forward is folded into one 2B-batch
     model call;
-  * the Python view loop only swaps the record buffer between scans, so
-    one jit compilation serves every view.
+  * the record buffer is DEVICE-RESIDENT across the autoregressive loop
+    (:func:`diff3d_tpu.diffusion.sample_view`): each view step takes the
+    record as a donated jit argument, writes its output in place via
+    ``lax.dynamic_update_slice``, and returns the updated carry.  The
+    Python view loop just threads device handles — zero per-view
+    host->device re-upload (the pre-resident loop re-staged the whole
+    ``[capacity, B, H, W, 3]`` buffer every view: O(views^2) transfer
+    bytes and a host round-trip bubble per view), and ONE device->host
+    fetch at the end of the object;
+  * with an optional :class:`~diff3d_tpu.parallel.MeshEnv`, every
+    object-batched entry point compiles with ``NamedSharding`` in/out
+    specs — the object axis rides the mesh's ``data`` axis, params are
+    placed per the ``replicated``/``fsdp`` policy — so
+    ``synthesize_many``, ``eval_cli``, and the serving engine fan one
+    batched scan over every attached chip.
+
+The device-resident record contract (shared by offline and serving paths;
+see DESIGN.md): ``record_R``/``record_T`` are pre-filled with ALL target
+poses up front — the stochastic-conditioning draw only reads entries
+``< record_len``, so entry ``record_len`` doubles as the pose of the view
+being synthesised — and the per-object ``rng`` is carried on device and
+split inside the compiled step, preserving the legacy host loop's exact
+key stream (the serving bit-parity tests pin this).
 
 The per-view unit of work is public API: :meth:`Sampler.step` (one object)
 and :meth:`Sampler.step_many` (N objects, per-object view steps) run one
-view's full reverse diffusion; ``synthesize``/``synthesize_many`` are thin
-host loops over them.  The serving layer (``diff3d_tpu/serving``) drives
-``step_many`` directly so live requests at *different* autoregressive
-depths share one compiled scan (continuous batching at view granularity).
+view's full reverse diffusion and return the updated record carry;
+``synthesize``/``synthesize_many`` are thin host loops over them.  The
+serving layer (``diff3d_tpu/serving``) drives ``step_many`` directly so
+live requests at *different* autoregressive depths share one compiled scan
+(continuous batching at view granularity).
 """
 
 from __future__ import annotations
@@ -36,8 +58,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from diff3d_tpu.config import Config
-from diff3d_tpu.diffusion import (sample_loop, sample_loop_prepare,
-                                  sample_loop_scan)
+from diff3d_tpu.diffusion import (sample_loop_prepare, sample_loop_scan,
+                                  sample_view, sample_view_commit)
 from diff3d_tpu.models import XUNet
 
 
@@ -88,13 +110,23 @@ class Sampler:
         direct-attached hardware; raise it where a single multi-minute
         execution trips an RPC deadline (the full-width 128^2 sampler
         over the dev tunnel needs ~4).
+      mesh: optional :class:`~diff3d_tpu.parallel.MeshEnv`.  When given,
+        the object-batched entry points compile with ``NamedSharding``
+        in/out specs (object axis over the mesh's data axis, params per
+        the config's ``replicated``/``fsdp``/``tp`` policy) and
+        :attr:`lane_multiple` becomes the data-axis size — callers of
+        :meth:`step_many` must pass an object count divisible by it
+        (``synthesize_many`` pads internally; the serving engine rounds
+        its lane counts).  With ``cfg.mesh.context_parallel`` on, the
+        single-object path additionally threads
+        ``MeshEnv.activation_constraint()`` through the model.
     """
 
     def __init__(self, model: XUNet, params, cfg: Config,
-                 scan_chunks: int = 1):
+                 scan_chunks: int = 1, mesh=None):
         self.model = model
-        self.params = params
         self.cfg = cfg
+        self.mesh = mesh
         self.w = jnp.asarray(cfg.diffusion.guidance_weights, jnp.float32)
 
         d = cfg.diffusion
@@ -104,63 +136,118 @@ class Sampler:
                 f"timesteps={d.timesteps}")
         self.scan_chunks = scan_chunks
 
+        # Sharding vocabulary.  lane_multiple is the divisibility quantum
+        # of the object axis: NamedSharding rejects a leading dim not
+        # divisible by the data-axis size, so batched callers round up to
+        # a multiple (padding lanes carry live data and are discarded).
+        constrain = None
+        if mesh is not None:
+            self.lane_multiple = mesh.data_size
+            self._obj = mesh.batch()             # object axis over 'data'
+            self._rep = mesh.replicated()
+            self._param_shardings = mesh.params(params)
+            params = jax.device_put(params, self._param_shardings)
+            if cfg.mesh.context_parallel:
+                constrain = mesh.activation_constraint()
+        else:
+            self.lane_multiple = 1
+            self._obj = self._rep = self._param_shardings = None
+        self.params = params
+
         # params is a jit ARGUMENT, not a closure constant: closing over
         # it would bake the full weight set into the compiled program
         # (hundreds of MB at srn64 scale) and force a recompile for every
         # checkpoint swap.
-        def run(params, record_imgs, record_R, record_T, record_len,
-                target_R, target_T, K, rng):
+        def denoise_with(params, constrain=None):
             def denoise(batch, cond_mask):
                 return model.apply({"params": params}, batch,
-                                   cond_mask=cond_mask)
+                                   cond_mask=cond_mask, constrain=constrain)
+            return denoise
 
-            return sample_loop(
-                denoise, record_imgs=record_imgs, record_R=record_R,
-                record_T=record_T, record_len=record_len,
-                target_R=target_R, target_T=target_T, K=K, w=self.w,
-                rng=rng, timesteps=d.timesteps, logsnr_min=d.logsnr_min,
+        # The device-resident view step: (params, record carry) ->
+        # (out, record carry').  record_imgs is DONATED — the
+        # dynamic_update_slice writes in place on device.
+        def run_view(params, record_imgs, record_R, record_T, record_len,
+                     K, rng, constrain=None):
+            return sample_view(
+                denoise_with(params, constrain), record_imgs=record_imgs,
+                record_R=record_R, record_T=record_T,
+                record_len=record_len, K=K, w=self.w, rng=rng,
+                timesteps=d.timesteps, logsnr_min=d.logsnr_min,
                 logsnr_max=d.logsnr_max, clip_x0=d.clip_x0)
 
-        # Chunked pieces: `prepare` + `chunk` compose to exactly `run`
-        # (scan over xs == fold of scans over xs slices), but each chunk
-        # is its own device execution.
-        def prepare(record_len, rng, record_imgs):
-            return sample_loop_prepare(
-                record_len=record_len, rng=rng, timesteps=d.timesteps,
-                shape=(self.w.shape[0],) + record_imgs.shape[-3:],
-                logsnr_min=d.logsnr_min, logsnr_max=d.logsnr_max)
-
-        def chunk(params, state, xs, record_imgs, record_R, record_T,
-                  target_R, target_T, K):
-            def denoise(batch, cond_mask):
-                return model.apply({"params": params}, batch,
-                                   cond_mask=cond_mask)
-
-            return sample_loop_scan(
-                denoise, state, xs, record_imgs=record_imgs,
-                record_R=record_R, record_T=record_T, target_R=target_R,
-                target_T=target_T, K=K, w=self.w,
-                logsnr_max=d.logsnr_max, clip_x0=d.clip_x0)
+        def _specs(data_sharding, n_data_args, n_outs):
+            """jit sharding kwargs (empty off-mesh)."""
+            if mesh is None:
+                return {}
+            return {
+                "in_shardings": ((self._param_shardings,)
+                                 + (data_sharding,) * n_data_args),
+                "out_shardings": ((data_sharding,) * n_outs
+                                  if n_outs > 1 else data_sharding),
+            }
 
         if scan_chunks == 1:
-            self._run = jax.jit(run)
+            self._run_view = jax.jit(
+                lambda p, ri, rR, rT, rl, K, rng: run_view(
+                    p, ri, rR, rT, rl, K, rng, constrain=constrain),
+                donate_argnums=(1,), **_specs(self._rep, 6, 4))
         else:
-            jit_prepare = jax.jit(prepare)
-            jit_chunk = jax.jit(chunk)
-            n_per = d.timesteps // scan_chunks
+            # Chunked pieces: `prepare` + chunks + `commit` compose to
+            # exactly `run_view` (scan over xs == fold of scans over xs
+            # slices; the rng split and the record write bracket them),
+            # but each chunk is its own device execution.  All pieces
+            # take/return device carries, so the chunked path is equally
+            # host-transfer-free between views.
+            def prepare_view(record_len, rng, record_imgs):
+                rng, k = jax.random.split(rng)
+                state, xs = sample_loop_prepare(
+                    record_len=record_len, rng=k, timesteps=d.timesteps,
+                    shape=(self.w.shape[0],) + record_imgs.shape[-3:],
+                    logsnr_min=d.logsnr_min, logsnr_max=d.logsnr_max)
+                return state, xs, rng
 
-            def run_chunked(params, record_imgs, record_R, record_T,
-                            record_len, target_R, target_T, K, rng):
-                state, xs = jit_prepare(record_len, rng, record_imgs)
+            def chunk_view(params, state, xs, record_imgs, record_R,
+                           record_T, record_len, K, constrain=None):
+                return sample_loop_scan(
+                    denoise_with(params, constrain), state, xs,
+                    record_imgs=record_imgs, record_R=record_R,
+                    record_T=record_T, target_R=record_R[record_len],
+                    target_T=record_T[record_len], K=K, w=self.w,
+                    logsnr_max=d.logsnr_max, clip_x0=d.clip_x0)
+
+            n_per = d.timesteps // scan_chunks
+            sh = {} if mesh is None else {"out_shardings": self._rep}
+            jit_prepare = jax.jit(
+                prepare_view,
+                **({} if mesh is None
+                   else {"in_shardings": (self._rep,) * 3, **sh}))
+            jit_chunk = jax.jit(
+                lambda p, s, xs, ri, rR, rT, rl, K: chunk_view(
+                    p, s, xs, ri, rR, rT, rl, K, constrain=constrain),
+                **({} if mesh is None
+                   else {"in_shardings": (self._param_shardings,)
+                         + (self._rep,) * 7, **sh}))
+            jit_commit = jax.jit(
+                sample_view_commit, donate_argnums=(0,),
+                **({} if mesh is None
+                   else {"in_shardings": (self._rep,) * 3,
+                         "out_shardings": (self._rep,) * 3}))
+
+            def run_view_chunked(params, record_imgs, record_R, record_T,
+                                 record_len, K, rng):
+                state, xs, rng = jit_prepare(record_len, rng, record_imgs)
                 for c in range(scan_chunks):
                     sl = jax.tree.map(
                         lambda x: x[c * n_per:(c + 1) * n_per], xs)
                     state = jit_chunk(params, state, sl, record_imgs,
-                                      record_R, record_T, target_R,
-                                      target_T, K)
-                return state.img
+                                      record_R, record_T, record_len, K)
+                out, record_imgs, record_len = jit_commit(
+                    record_imgs, record_len, state.img)
+                return out, record_imgs, record_len, rng
 
-            self._run = run_chunked
+            self._run_view = run_view_chunked
+
         # Object-batched variant: vmap folds an extra leading object axis
         # into every model call (N*2B examples instead of 2B), so N
         # independent objects' guidance sweeps share one compiled scan —
@@ -168,79 +255,136 @@ class Sampler:
         # per-object loop was the eval cost center.  record_len is batched
         # per object (in_axes 0): the offline path passes the same step
         # for every object, while the serving engine mixes requests at
-        # different autoregressive depths in one device batch.
+        # different autoregressive depths in one device batch.  On a mesh
+        # the object axis is sharded over 'data', so one launch spans all
+        # chips.  (The context-parallel constrain hook is single-object
+        # only: under vmap its [B, F, H, W, C] spec would land on the
+        # wrong axes.)
         if scan_chunks == 1:
-            self._run_many = jax.jit(jax.vmap(
-                run, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0)))
+            self._run_view_many = jax.jit(
+                jax.vmap(run_view, in_axes=(None, 0, 0, 0, 0, 0, 0)),
+                donate_argnums=(1,), **_specs(self._obj, 6, 4))
         else:
-            jit_prepare_many = jax.jit(jax.vmap(prepare,
-                                                in_axes=(0, 0, 0)))
-            jit_chunk_many = jax.jit(jax.vmap(
-                chunk, in_axes=(None, 0, 0, 0, 0, 0, 0, 0, 0)))
+            jit_prepare_many = jax.jit(
+                jax.vmap(prepare_view, in_axes=(0, 0, 0)),
+                **({} if mesh is None
+                   else {"in_shardings": (self._obj,) * 3,
+                         "out_shardings": self._obj}))
+            jit_chunk_many = jax.jit(
+                jax.vmap(chunk_view, in_axes=(None, 0, 0, 0, 0, 0, 0, 0)),
+                **({} if mesh is None
+                   else {"in_shardings": (self._param_shardings,)
+                         + (self._obj,) * 7,
+                         "out_shardings": self._obj}))
+            jit_commit_many = jax.jit(
+                jax.vmap(sample_view_commit, in_axes=(0, 0, 0)),
+                donate_argnums=(0,),
+                **({} if mesh is None
+                   else {"in_shardings": (self._obj,) * 3,
+                         "out_shardings": (self._obj,) * 3}))
             n_per_many = d.timesteps // scan_chunks
 
-            def run_many_chunked(params, record_imgs, record_R, record_T,
-                                 record_len, target_R, target_T, K, rngs):
-                state, xs = jit_prepare_many(record_len, rngs, record_imgs)
+            def run_view_many_chunked(params, record_imgs, record_R,
+                                      record_T, record_len, K, rngs):
+                state, xs, rngs = jit_prepare_many(record_len, rngs,
+                                                   record_imgs)
                 for c in range(scan_chunks):
                     sl = jax.tree.map(
                         lambda x: x[:, c * n_per_many:(c + 1) * n_per_many],
                         xs)
-                    state = jit_chunk_many(
-                        params, state, sl, record_imgs, record_R,
-                        record_T, target_R, target_T, K)
-                return state.img
+                    state = jit_chunk_many(params, state, sl, record_imgs,
+                                           record_R, record_T, record_len,
+                                           K)
+                out, record_imgs, record_len = jit_commit_many(
+                    record_imgs, record_len, state.img)
+                return out, record_imgs, record_len, rngs
 
-            self._run_many = run_many_chunked
+            self._run_view_many = run_view_many_chunked
 
     # ------------------------------------------------------------------
     # Per-view step API (public): one view's full reverse diffusion.
     # ------------------------------------------------------------------
 
-    def step(self, record_imgs, record_R, record_T, step, target_R,
-             target_T, K, key, *, params=None):
-        """One view's reverse diffusion for ONE object.
+    def step(self, record_imgs, record_R, record_T, step, K, rng, *,
+             params=None):
+        """One view's reverse diffusion for ONE object, device-resident.
 
         Args:
           record_imgs / record_R / record_T: ``[capacity, B, H, W, 3]`` /
             ``[capacity, 3, 3]`` / ``[capacity, 3]`` record buffers
-            (see :func:`record_capacity`).
+            (see :func:`record_capacity`).  The pose buffers must be
+            pre-filled with every view's pose — entry ``step`` is the
+            target pose of the view being synthesised.
           step: number of valid record entries (== the view index being
             synthesised).
-          target_R / target_T: pose of the view to synthesise.
           K: ``[3, 3]`` intrinsics.
-          key: per-view PRNG key.
+          rng: the per-object PRNG carry (NOT a per-view key — the
+            per-view key is split off inside the compiled step, exactly
+            like the legacy host loop did).
           params: optional parameter pytree overriding the constructor
             default (same treedef/shapes — no recompile).
         Returns:
-          ``[B, H, W, 3]`` device array (not fetched; callers block).
+          ``(out, record_imgs, step + 1, rng)`` — ``out`` is the
+          ``[B, H, W, 3]`` generated view (device array; callers block),
+          and the rest is the updated record carry for the next view.
+          ``record_imgs`` is DONATED: a passed-in device buffer is
+          invalidated and the returned one must be used instead (numpy
+          inputs are unaffected — donation of host memory is a no-op).
         """
         p = self.params if params is None else params
-        return self._run(p, jnp.asarray(record_imgs),
-                         jnp.asarray(record_R), jnp.asarray(record_T),
-                         jnp.asarray(step), jnp.asarray(target_R),
-                         jnp.asarray(target_T), jnp.asarray(K), key)
+        return self._run_view(
+            p, jnp.asarray(record_imgs), jnp.asarray(record_R),
+            jnp.asarray(record_T), jnp.asarray(step, jnp.int32),
+            jnp.asarray(K), jnp.asarray(rng))
 
-    def step_many(self, record_imgs, record_R, record_T, steps, target_R,
-                  target_T, K, keys, *, params=None):
+    def step_many(self, record_imgs, record_R, record_T, steps, K, rngs,
+                  *, params=None):
         """One view step for N objects in ONE batched program.
 
         Everything gains a leading object axis; ``steps`` is ``[N]`` —
         per-object record lengths, so co-batched objects may sit at
         different autoregressive depths (the serving engine's continuous
-        batching relies on this).  ``keys`` is ``[N]`` stacked PRNG keys.
-        Returns ``[N, B, H, W, 3]`` (device array).
+        batching relies on this).  ``rngs`` is ``[N]`` stacked per-object
+        PRNG carries (split per view inside, like :meth:`step`).  On a
+        mesh, N must be a multiple of :attr:`lane_multiple` (the sharded
+        program cannot split a non-divisible object axis).  Returns
+        ``(out [N, B, H, W, 3], record_imgs, steps + 1, rngs)`` with the
+        same donation contract as :meth:`step`.
         """
+        n = int(np.shape(record_imgs)[0])
+        if n % self.lane_multiple:
+            raise ValueError(
+                f"step_many: {n} objects is not a multiple of the mesh's "
+                f"data-axis size {self.lane_multiple} — pad the batch "
+                "(repeat a live lane; padded outputs are discarded) or "
+                "use synthesize_many, which pads internally")
         p = self.params if params is None else params
-        return self._run_many(
+        return self._run_view_many(
             p, jnp.asarray(record_imgs), jnp.asarray(record_R),
-            jnp.asarray(record_T), jnp.asarray(steps),
-            jnp.asarray(target_R), jnp.asarray(target_T),
-            jnp.asarray(K), keys)
+            jnp.asarray(record_T), jnp.asarray(steps, jnp.int32),
+            jnp.asarray(K), jnp.asarray(rngs))
 
     # ------------------------------------------------------------------
-    # Offline loops: thin host loops over the step API.
+    # Offline loops: thin host loops threading the device-resident carry.
     # ------------------------------------------------------------------
+
+    def _record_init(self, imgs0, R, T, n_views):
+        """Host-side record build: view 0 seeded, ALL poses pre-filled
+        (the device-resident contract — see the module docstring)."""
+        B = int(self.w.shape[0])
+        H, W = imgs0.shape[-3:-1]
+        capacity = record_capacity(n_views) if n_views > 1 else 1
+        record_imgs = np.zeros((capacity, B, H, W, 3), np.float32)
+        record_R = np.zeros((capacity, 3, 3), np.float32)
+        record_T = np.zeros((capacity, 3), np.float32)
+        record_imgs[0] = imgs0[None]
+        record_R[:n_views] = R[:n_views]
+        record_T[:n_views] = T[:n_views]
+        return record_imgs, record_R, record_T
+
+    def _put(self, x, sharding):
+        return (jnp.asarray(x) if self.mesh is None
+                else jax.device_put(x, sharding))
 
     def synthesize(self, views: Dict[str, np.ndarray], rng: jax.Array,
                    out_dir: Optional[str] = None,
@@ -248,44 +392,52 @@ class Sampler:
         """Autoregressively synthesise every view of ``views`` (the dict
         produced by ``SRNDataset.all_views``) from view 0.
 
-        Returns ``[n_views-1, B, H, W, 3]`` generated images (B = number of
-        guidance weights).  When ``out_dir`` is given, saves
+        The record carry stays on device for the whole loop; the only
+        device->host traffic is ONE fetch of the generated views at the
+        end (PNGs, when requested, are written from that fetch).
+
+        Returns ``[n_views-1, B, H, W, 3]`` generated images (B = number
+        of guidance weights).  When ``out_dir`` is given, saves
         ``{out_dir}/{step}/gt.png`` and ``{out_dir}/{step}/{i}.png`` per
         view — the reference's output layout (``sampling.py:179-182``).
         """
-        imgs, R, T, K = (views["imgs"], views["R"], views["T"],
-                         jnp.asarray(views["K"]))
+        imgs = np.asarray(views["imgs"], np.float32)
+        R = np.asarray(views["R"], np.float32)
+        T = np.asarray(views["T"], np.float32)
+        K = np.asarray(views["K"], np.float32)
         n_views = imgs.shape[0] if max_views is None else min(
             imgs.shape[0], max_views)
-        B = self.w.shape[0]
+        B = int(self.w.shape[0])
         H, W = imgs.shape[1:3]
+        if n_views < 2:
+            return np.zeros((0, B, H, W, 3), np.float32)
 
-        # Fixed-size record buffer; entry 0 is the GT first view repeated
-        # across the guidance batch (reference sampling.py:160-162).
-        capacity = record_capacity(n_views) if n_views > 1 else 1
-        record_imgs = np.zeros((capacity, B, H, W, 3), np.float32)
-        record_R = np.zeros((capacity, 3, 3), np.float32)
-        record_T = np.zeros((capacity, 3), np.float32)
-        record_imgs[0] = imgs[0][None]
-        record_R[0], record_T[0] = R[0], T[0]
+        record_imgs, record_R, record_T = self._record_init(
+            imgs[0], R, T, n_views)
 
-        outs = []
-        for step in range(1, n_views):
-            rng, k = jax.random.split(rng)
-            out = self.step(record_imgs, record_R, record_T, step,
-                            R[step], T[step], K, k)
-            out = np.asarray(jax.block_until_ready(out))
-            record_imgs[step] = out
-            record_R[step], record_T[step] = R[step], T[step]
-            outs.append(out)
+        # One-time upload of the carry; after this the loop only threads
+        # returned device handles (rec_i is donated each step and written
+        # in place).
+        rec_i = self._put(record_imgs, self._rep)
+        rec_R = self._put(record_R, self._rep)
+        rec_T = self._put(record_T, self._rep)
+        K_d = self._put(K, self._rep)
+        step_d = self._put(np.asarray(1, np.int32), self._rep)
+        rng_d = self._put(np.asarray(rng), self._rep)
+        for _ in range(1, n_views):
+            _, rec_i, step_d, rng_d = self._run_view(
+                self.params, rec_i, rec_R, rec_T, step_d, K_d, rng_d)
+        # Single fetch: slice the generated views on device, pull once.
+        outs = np.asarray(jax.block_until_ready(rec_i[1:n_views]))
 
-            if out_dir is not None:
+        if out_dir is not None:
+            for step in range(1, n_views):
                 save_image(os.path.join(out_dir, str(step), "gt.png"),
                            imgs[step])
                 for i in range(B):
-                    save_image(
-                        os.path.join(out_dir, str(step), f"{i}.png"), out[i])
-        return np.stack(outs) if outs else np.zeros((0, B, H, W, 3))
+                    save_image(os.path.join(out_dir, str(step), f"{i}.png"),
+                               outs[step - 1, i])
+        return outs
 
     def synthesize_many(self, views_list: Sequence[Dict[str, np.ndarray]],
                         rngs: Sequence[jax.Array],
@@ -293,13 +445,18 @@ class Sampler:
         """Autoregressively synthesise N objects' views in ONE batched
         program (objects are independent — the reference scores them
         strictly sequentially, ``sampling.py:169-184``; here the object
-        axis becomes an extra batch dim on every model call).
+        axis becomes an extra batch dim on every model call, sharded over
+        the mesh's data axis when a mesh is attached).
 
         ``rngs`` holds one key per object.  Given the same per-object key,
         the per-object rng stream is identical to a sequential
         ``synthesize(views, key)`` call, so results match the sequential
         path to float tolerance (XLA may tile the larger batch
         differently, so bitwise equality is not guaranteed).
+
+        On a mesh, N is padded internally to a multiple of
+        :attr:`lane_multiple` by repeating object 0 (live data — zero
+        lanes would run denormal-slow); padded outputs are discarded.
 
         Every object contributes ``n_views = min(min_i views_i,
         max_views)`` views — batch objects with equal view counts to avoid
@@ -310,34 +467,34 @@ class Sampler:
         n_views = min(v["imgs"].shape[0] for v in views_list)
         if max_views is not None:
             n_views = min(n_views, max_views)
-        B = self.w.shape[0]
+        B = int(self.w.shape[0])
         H, W = views_list[0]["imgs"].shape[1:3]
+        if n_views < 2:
+            return np.zeros((N, 0, B, H, W, 3), np.float32)
 
-        capacity = record_capacity(n_views) if n_views > 1 else 1
-        record_imgs = np.zeros((N, capacity, B, H, W, 3), np.float32)
-        record_R = np.zeros((N, capacity, 3, 3), np.float32)
-        record_T = np.zeros((N, capacity, 3), np.float32)
-        Rs = np.stack([np.asarray(v["R"][:n_views], np.float32)
-                       for v in views_list])
-        Ts = np.stack([np.asarray(v["T"][:n_views], np.float32)
-                       for v in views_list])
-        Ks = np.stack([np.asarray(v["K"], np.float32) for v in views_list])
-        for i, v in enumerate(views_list):
-            record_imgs[i, 0] = v["imgs"][0][None]
-        record_R[:, 0], record_T[:, 0] = Rs[:, 0], Ts[:, 0]
+        mult = self.lane_multiple
+        pad_idx = list(range(N)) + [0] * (-N % mult)
+        recs = [self._record_init(
+                    np.asarray(views_list[i]["imgs"][0], np.float32),
+                    np.asarray(views_list[i]["R"], np.float32),
+                    np.asarray(views_list[i]["T"], np.float32), n_views)
+                for i in pad_idx]
+        record_imgs = np.stack([r[0] for r in recs])
+        record_R = np.stack([r[1] for r in recs])
+        record_T = np.stack([r[2] for r in recs])
+        Ks = np.stack([np.asarray(views_list[i]["K"], np.float32)
+                       for i in pad_idx])
+        keys = np.stack([np.asarray(rngs[i]) for i in pad_idx])
+        steps = np.full((len(pad_idx),), 1, np.int32)
 
-        keys = jnp.stack([jnp.asarray(k) for k in rngs])
-        outs = []
-        for step in range(1, n_views):
-            split = jax.vmap(jax.random.split)(keys)     # [N, 2, key]
-            keys, step_keys = split[:, 0], split[:, 1]
-            out = self.step_many(
-                record_imgs, record_R, record_T,
-                np.full((N,), step, np.int32),
-                Rs[:, step], Ts[:, step], Ks, step_keys)
-            out = np.asarray(jax.block_until_ready(out))  # [N, B, H, W, 3]
-            record_imgs[:, step] = out
-            record_R[:, step], record_T[:, step] = Rs[:, step], Ts[:, step]
-            outs.append(out)
-        return (np.stack(outs, axis=1) if outs
-                else np.zeros((N, 0, B, H, W, 3)))
+        rec_i = self._put(record_imgs, self._obj)
+        rec_R = self._put(record_R, self._obj)
+        rec_T = self._put(record_T, self._obj)
+        Ks_d = self._put(Ks, self._obj)
+        steps_d = self._put(steps, self._obj)
+        keys_d = self._put(keys, self._obj)
+        for _ in range(1, n_views):
+            _, rec_i, steps_d, keys_d = self._run_view_many(
+                self.params, rec_i, rec_R, rec_T, steps_d, Ks_d, keys_d)
+        # Single fetch: drop padding lanes + the seeded view 0 on device.
+        return np.asarray(jax.block_until_ready(rec_i[:N, 1:n_views]))

@@ -95,8 +95,11 @@ class ProgramCache:
             else None
 
     def step_many(self, bucket, lanes: int, record_imgs, record_R,
-                  record_T, steps, target_R, target_T, K, keys, *,
-                  params=None):
+                  record_T, steps, K, rngs, *, params=None):
+        """Run one batched view step (device-resident signature: the pose
+        buffers carry every view's pose, ``rngs`` are per-lane PRNG
+        carries split inside).  Returns the sampler's full
+        ``(out, record_imgs, steps + 1, rngs)`` carry tuple."""
         key = (tuple(bucket), int(lanes))
         with self._lock:
             entry = self._programs.get(key)
@@ -110,8 +113,7 @@ class ProgramCache:
             self._hits.inc()
         t0 = time.monotonic()
         out = self._sampler.step_many(record_imgs, record_R, record_T,
-                                      steps, target_R, target_T, K, keys,
-                                      params=params)
+                                      steps, K, rngs, params=params)
         if first:
             out = jax.block_until_ready(out)
             with self._lock:
@@ -136,9 +138,7 @@ class ProgramCache:
             np.zeros((N, cap, 3), np.float32),
             np.ones((N,), np.int32),
             np.zeros((N, 3, 3), np.float32),
-            np.zeros((N, 3), np.float32),
-            np.zeros((N, 3, 3), np.float32),
-            jax.numpy.stack([jax.random.PRNGKey(i) for i in range(N)]),
+            np.stack([np.asarray(jax.random.PRNGKey(i)) for i in range(N)]),
             params=params)
         jax.block_until_ready(out)
         return time.monotonic() - t0

@@ -23,9 +23,22 @@ synthesize``), so a served result is bit-identical to
 
 Batch shapes are quantised: the active set is padded to the next power of
 two lanes (<= ``ServingConfig.max_batch``) by repeating a live lane, so
-each bucket owns a logarithmic number of compiled programs.  Padding lanes
-burn real FLOPs — the occupancy/padding histograms exist precisely to make
-that waste visible.
+each bucket owns a logarithmic number of compiled programs.  When the
+sampler rides a mesh, lane counts are additionally rounded up to a
+multiple of its ``lane_multiple`` (the mesh's data-axis size) — a sharded
+program cannot split a non-divisible object axis, so without the rounding
+an odd admission count would recompile (or crash) instead of padding.
+Padding lanes burn real FLOPs — the occupancy/padding histograms exist
+precisely to make that waste visible.
+
+The engine keeps each request's record buffer on the HOST and re-stages
+the active set every view step (unlike the offline ``synthesize`` loops,
+which thread a device-resident donated carry): continuous batching
+re-forms the lane set at every view boundary, so per-slot host buffers are
+what let a fresh request join mid-flight without reshuffling device
+memory.  The cost of that choice is measured, not hidden — the
+``serving_host_{upload,fetch}_bytes_total`` counters track exactly how
+many bytes cross the host boundary per step.
 """
 
 from __future__ import annotations
@@ -49,9 +62,17 @@ from diff3d_tpu.utils.profiling import StepTimer
 log = logging.getLogger(__name__)
 
 
-def _pow2_lanes(n: int, max_batch: int) -> int:
-    """Smallest power of two >= n, clamped to max_batch."""
-    return min(1 << (n - 1).bit_length(), max_batch) if n else 0
+def lane_count(n: int, max_batch: int, multiple: int = 1) -> int:
+    """Launch lanes for ``n`` live requests: smallest power of two >= n,
+    rounded up to ``multiple`` (the sampler's mesh quantum — a sharded
+    object axis must divide by the data-axis size), clamped to
+    ``max_batch`` (itself pre-rounded by the engine when ``multiple`` >
+    1)."""
+    if not n:
+        return 0
+    lanes = 1 << (n - 1).bit_length()
+    lanes = -(-lanes // multiple) * multiple
+    return min(lanes, max_batch)
 
 
 class _Slot:
@@ -65,9 +86,17 @@ class _Slot:
         self.record_R = np.zeros((cap, 3, 3), np.float32)
         self.record_T = np.zeros((cap, 3), np.float32)
         self.record_imgs[0] = req.imgs0[None]
-        self.record_R[0], self.record_T[0] = req.R[0], req.T[0]
+        # Device-resident record contract: ALL poses pre-filled — entry
+        # ``step`` doubles as the target pose of the view being
+        # synthesised (the stochastic-conditioning draw only reads
+        # entries < step, so future poses never leak into sampling).
+        self.record_R[:req.n_views] = req.R[:req.n_views]
+        self.record_T[:req.n_views] = req.T[:req.n_views]
         self.step = 1                       # next view index to synthesise
-        self.rng = jax.random.PRNGKey(req.seed)
+        # Per-request PRNG carry; the per-view key split happens INSIDE
+        # the compiled step (sample_view), preserving the offline loop's
+        # exact stream.
+        self.rng = np.asarray(jax.random.PRNGKey(req.seed))
         self.outs: List[np.ndarray] = []
 
 
@@ -88,6 +117,16 @@ class Engine:
             cfg.result_cache_entries, metrics)
         self.programs = program_cache or ProgramCache(sampler, metrics)
         self.guidance_B = int(sampler.w.shape[0])
+        # Mesh quantum: every launched lane count must divide by the
+        # sampler's data-axis size, including the admission ceiling.
+        self.lane_multiple = int(getattr(sampler, "lane_multiple", 1) or 1)
+        self.max_batch = (-(-cfg.max_batch // self.lane_multiple)
+                          * self.lane_multiple)
+        if self.max_batch != cfg.max_batch:
+            log.warning(
+                "serving max_batch rounded %d -> %d (mesh data-axis "
+                "size %d)", cfg.max_batch, self.max_batch,
+                self.lane_multiple)
         self.step_timer = StepTimer(window=512)
 
         m = metrics
@@ -116,6 +155,12 @@ class Engine:
                                 "submit -> full result")
         self._queue_wait = m.histogram("serving_queue_wait_seconds",
                                        "submit -> admission to a lane")
+        self._upload_bytes = m.counter(
+            "serving_host_upload_bytes_total",
+            "host->device bytes staged for view-step batches")
+        self._fetch_bytes = m.counter(
+            "serving_host_fetch_bytes_total",
+            "device->host bytes fetched from view-step batches")
 
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -162,6 +207,9 @@ class Engine:
             "engine": {
                 "alive": self.alive,
                 "params_version": self.registry.version,
+                "lane_multiple": self.lane_multiple,
+                "max_batch": self.max_batch,
+                "num_devices": jax.device_count(),
                 "step_timer": self.step_timer.summary(),
                 "program_cache": self.programs.stats(),
                 "result_cache_entries": len(self.result_cache),
@@ -193,12 +241,12 @@ class Engine:
             self._active_g.set(0)
 
     def _admit(self, active: List[_Slot]) -> List[_Slot]:
-        free = self.cfg.max_batch - len(active)
+        free = self.max_batch - len(active)
         if active:
             got = self.scheduler.acquire(active[0].req.bucket, free,
                                          block=False) if free > 0 else []
         else:
-            got = self.scheduler.acquire(None, self.cfg.max_batch,
+            got = self.scheduler.acquire(None, self.max_batch,
                                          block=True, poll_s=0.2)
         now = time.monotonic()
         for req in got:
@@ -210,7 +258,7 @@ class Engine:
 
     def _run_view_step(self, active: List[_Slot]) -> None:
         n = len(active)
-        lanes = _pow2_lanes(n, self.cfg.max_batch)
+        lanes = lane_count(n, self.max_batch, self.lane_multiple)
         pad = lanes - n
         # Pad by repeating lane 0 (live data: zero-filled lanes would
         # still run the full scan, and denormals/NaN paths can be slower
@@ -220,27 +268,25 @@ class Engine:
         record_R = np.stack([active[i].record_R for i in idx])
         record_T = np.stack([active[i].record_T for i in idx])
         steps = np.asarray([active[i].step for i in idx], np.int32)
-        target_R = np.stack([active[i].req.R[active[i].step] for i in idx])
-        target_T = np.stack([active[i].req.T[active[i].step] for i in idx])
         Ks = np.stack([active[i].req.K for i in idx])
-
-        # Per-request RNG stream: identical to the offline synthesize
-        # loop's `rng, k = jax.random.split(rng)` per view.
-        step_keys = []
-        for slot in active:
-            slot.rng, k = jax.random.split(slot.rng)
-            step_keys.append(k)
-        keys = jax.numpy.stack(step_keys
-                               + [step_keys[0]] * pad)
+        # Per-lane PRNG carries — the per-view split happens inside the
+        # compiled step, so the stream is identical to the offline
+        # synthesize loop's.
+        rngs = np.stack([active[i].rng for i in idx])
+        self._upload_bytes.inc(record_imgs.nbytes + record_R.nbytes
+                               + record_T.nbytes + steps.nbytes
+                               + Ks.nbytes + rngs.nbytes)
 
         version, params = self.registry.current()
         bucket = active[0].req.bucket
         t0 = time.monotonic()
-        out = self.programs.step_many(
-            bucket, lanes, record_imgs, record_R, record_T, steps,
-            target_R, target_T, Ks, keys, params=params)
+        out, _, _, new_rngs = self.programs.step_many(
+            bucket, lanes, record_imgs, record_R, record_T, steps, Ks,
+            rngs, params=params)
         out = np.asarray(jax.block_until_ready(out))
+        new_rngs = np.asarray(new_rngs)
         dt = time.monotonic() - t0
+        self._fetch_bytes.inc(out.nbytes + new_rngs.nbytes)
         self.step_timer.tick()
         self._view_lat.observe(dt)
         self._occupancy.observe(n)
@@ -251,8 +297,7 @@ class Engine:
         for i, slot in enumerate(active):
             view = out[i]
             slot.record_imgs[slot.step] = view
-            slot.record_R[slot.step] = slot.req.R[slot.step]
-            slot.record_T[slot.step] = slot.req.T[slot.step]
+            slot.rng = new_rngs[i]
             slot.outs.append(view)
             if slot.req.first_view_time is None:
                 slot.req.first_view_time = now

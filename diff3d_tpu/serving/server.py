@@ -171,6 +171,8 @@ class ServingService:
             "engine_alive": ok,
             "queue_depth": self.scheduler.depth(),
             "params_version": self.registry.version,
+            "lane_multiple": self.engine.lane_multiple,
+            "max_batch": self.engine.max_batch,
         }
 
     def metrics_snapshot(self) -> dict:

@@ -98,6 +98,9 @@ class CheckpointManager:
                     "ckpt mode 'full_sliced' is single-host only "
                     f"(process_count={jax.process_count()}); use 'full'")
             self._mgr = None
+            # Orbax handles interval gating for the managed modes; the
+            # sliced writer applies the same semantics itself in save().
+            self._save_interval = save_interval_steps or 1
             if is_primary():
                 os.makedirs(self._dir, exist_ok=True)
         else:
@@ -143,8 +146,10 @@ class CheckpointManager:
             if d.isdigit() and os.path.exists(
                 os.path.join(self._dir, d, _SLICED_MANIFEST)))
 
-    def _save_sliced(self, state: TrainState) -> bool:
+    def _save_sliced(self, state: TrainState, force: bool = False) -> bool:
         step = int(jax.device_get(state.step))
+        if not force and step % self._save_interval:
+            return False       # same gating Orbax applies in managed modes
         final = os.path.join(self._dir, str(step))
         if os.path.exists(final):
             return False
@@ -183,6 +188,13 @@ class CheckpointManager:
     def _restore_sliced(self, abstract_state: TrainState,
                         step: int | None) -> Optional[TrainState]:
         steps = self._sliced_steps()
+        if step is not None and step not in steps:
+            # An explicitly requested step that isn't there (never saved,
+            # or pruned by retention) is a caller error worth naming —
+            # not a raw FileNotFoundError from the manifest open below.
+            raise ValueError(
+                f"sliced checkpoint step {step} not found in {self._dir}; "
+                f"available steps: {steps or 'none'}")
         step = step if step is not None else (steps[-1] if steps else None)
         if step is None:
             return None
@@ -204,10 +216,19 @@ class CheckpointManager:
                     f"{tuple(meta['shape'])}, target expects "
                     f"{tuple(sds.shape)} — model/optimizer config "
                     "mismatch")
+            if meta["dtype"] != str(sds.dtype):
+                # A dtype mismatch is a config mismatch (e.g. restoring a
+                # float32 run into a bf16-param config): silently casting
+                # would hand back numerically different weights.
+                raise ValueError(
+                    f"sliced checkpoint at {d}: leaf {i} was saved as "
+                    f"{meta['dtype']}, target expects {sds.dtype} — "
+                    "model/optimizer config mismatch")
             arr = np.load(os.path.join(d, f"leaf_{i:05d}.npy"))
             if meta["dtype"] == "bfloat16":
                 arr = jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)
-            arr = jnp.asarray(arr).astype(sds.dtype)
+            else:
+                arr = jnp.asarray(arr)
             sharding = getattr(sds, "sharding", None)
             out.append(jax.device_put(arr, sharding)
                        if sharding is not None else arr)
@@ -217,7 +238,7 @@ class CheckpointManager:
 
     def save(self, state: TrainState, *, force: bool = False) -> bool:
         if self.mode == "full_sliced":
-            return self._save_sliced(state)
+            return self._save_sliced(state, force=force)
         step = int(jax.device_get(state.step))
         if self.mode == "ema_bf16":
             payload = {

@@ -317,6 +317,45 @@ def test_checkpoint_full_sliced_exact_roundtrip(tmp_path):
         CheckpointManager(str(tmp_path / "full"), mode="full_sliced")
 
 
+def test_checkpoint_full_sliced_guards(tmp_path):
+    """full_sliced error surfaces: a missing explicit step names the
+    available ones (not a raw FileNotFoundError), a saved-vs-target dtype
+    mismatch is a config error (not a silent cast), and
+    save_interval_steps/force gate saves like the Orbax modes."""
+    cfg = tiny_cfg()
+    model = XUNet(cfg.model)
+    rng = jax.random.PRNGKey(0)
+    state = create_train_state(init_params(model, cfg, rng), cfg.train)
+    step_fn = make_train_step(model, cfg, env=None, donate=False)
+    state, _ = step_fn(state, make_batch(cfg), rng)      # step 1
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=3,
+                            save_interval_steps=2, mode="full_sliced")
+    # interval gating: step 1 % 2 != 0 -> skipped unless forced
+    assert not mgr.save(state)
+    assert mgr._sliced_steps() == []
+    assert mgr.save(state, force=True)
+    state2, _ = step_fn(state, make_batch(cfg), rng)     # step 2
+    assert mgr.save(state2)                              # 2 % 2 == 0
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+    # explicit missing/pruned step: ValueError naming what IS there
+    with pytest.raises(ValueError, match=r"available steps: \[1, 2\]"):
+        mgr.restore(abstract, step=7)
+    # dtype mismatch = config mismatch, loudly (no silent .astype)
+    wrong = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, jnp.bfloat16 if x.dtype == jnp.float32 else x.dtype),
+        state)
+    with pytest.raises(ValueError, match="config mismatch"):
+        mgr.restore(wrong, step=1)
+    # ...and the matching restore still round-trips exactly
+    restored = mgr.restore(abstract, step=1)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_trainer_warm_restart_from_ema_bf16(tmp_path):
     cfg = tiny_cfg(max_steps=2, ckpt_every=2, log_every=1,
                    ckpt_mode="ema_bf16")

@@ -60,7 +60,14 @@ def _build_service(args):
         result_cache_entries=0))     # load bench must not replay results
     model = XUNet(cfg.model)
     params = init_params(model, cfg, jax.random.PRNGKey(0))
-    sampler = Sampler(model, params, cfg)
+    mesh_env = None
+    if args.mesh:
+        from diff3d_tpu.parallel import make_mesh
+
+        mesh_env = make_mesh(cfg.mesh)
+        print(f"bench_serving: mesh {dict(mesh_env.mesh.shape)} "
+              f"(lane multiple {mesh_env.data_size})", file=sys.stderr)
+    sampler = Sampler(model, params, cfg, mesh=mesh_env)
     return sampler, cfg
 
 
@@ -89,11 +96,16 @@ def _run_rate(sampler, cfg, rate: float, args) -> dict:
              for i in range(args.requests)]
     # Warm the fullest lane count so rate 0's first request doesn't pay
     # the compile (every rate would otherwise time one compile each).
+    # Lane counts go through the engine's rounding (power of two, then up
+    # to the mesh's lane multiple) so the warmed shapes are exactly the
+    # ones traffic will launch.
     from diff3d_tpu.sampling import record_capacity
+    from diff3d_tpu.serving.engine import lane_count
     bucket = (cfg.model.H, cfg.model.W, record_capacity(args.n_views))
-    for lanes in {1, min(cfg.serving.max_batch,
-                         1 << (args.requests - 1).bit_length()
-                         if args.requests else 1)}:
+    eng = service.engine
+    for lanes in {lane_count(1, eng.max_batch, eng.lane_multiple),
+                  lane_count(min(eng.max_batch, args.requests or 1),
+                             eng.max_batch, eng.lane_multiple)}:
         service.engine.programs.warmup(bucket, lanes, sampler.w.shape[0])
 
     from diff3d_tpu.serving.scheduler import ViewRequest
@@ -134,7 +146,15 @@ def _run_rate(sampler, cfg, rate: float, args) -> dict:
     views_done = snap["counters"].get("serving_views_completed_total", 0)
     occ = snap["histograms"].get("serving_batch_occupancy", {})
     padf = snap["histograms"].get("serving_batch_padding_fraction", {})
+    up_bytes = snap["counters"].get("serving_host_upload_bytes_total", 0)
+    fetch_bytes = snap["counters"].get("serving_host_fetch_bytes_total", 0)
     return {
+        "chips_used": service.engine.lane_multiple,
+        "lane_multiple": service.engine.lane_multiple,
+        "host_upload_bytes_per_view": (round(up_bytes / views_done)
+                                       if views_done else None),
+        "host_fetch_bytes_per_view": (round(fetch_bytes / views_done)
+                                      if views_done else None),
         "offered_rate_rps": rate,
         "requests": args.requests,
         "completed": len(latencies),
@@ -170,6 +190,9 @@ def main(argv=None) -> int:
     p.add_argument("--max_queue", type=int, default=256)
     p.add_argument("--max_wait_ms", type=float, default=50.0)
     p.add_argument("--timeout_s", type=float, default=600.0)
+    p.add_argument("--mesh", action="store_true",
+                   help="shard the sampler over cfg.mesh (lane counts "
+                        "round up to the data-axis size)")
     p.add_argument("--out", default="runs/bench_serving.json")
     args = p.parse_args(argv)
 
@@ -191,6 +214,8 @@ def main(argv=None) -> int:
         "config": args.config,
         "platform": jax.devices()[0].platform,
         "num_devices": len(jax.devices()),
+        "mesh": bool(args.mesh),
+        "lane_multiple": sampler.lane_multiple,
         "diffusion_steps": cfg.diffusion.timesteps,
         "n_views": args.n_views,
         "max_batch": args.max_batch,
