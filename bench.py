@@ -49,17 +49,22 @@ BASELINE_STEPS_PER_SEC = 101_000 / (120 * 3600)   # 8x3090, README.md:39
 BASELINE_EXAMPLES_PER_SEC = BASELINE_STEPS_PER_SEC * 128
 
 
-class BackendDialTimeout(TimeoutError):
-    """The backend dial HUNG past its SIGALRM budget (vs raising fast).
+# The dial-timeout type now lives in the shared retry shim (the serving
+# engine and trainer classify against the same type); re-exported here so
+# `bench.BackendDialTimeout` keeps working for the guard tests and any
+# harness that imports it.  Semantics unchanged: a hang is distinguished
+# from transient ``UNAVAILABLE``-style errors because the correct
+# responses differ — a fast transient error is worth re-dialing (r4's
+# outage recovered between attempts), but a hang consumes its full 180 s
+# per attempt, so it fails FAST with a parseable
+# ``{"error": "backend-dial-timeout"}`` record instead.
+from diff3d_tpu.runtime.retry import BackendDialTimeout  # noqa: E402
 
-    Distinguished from transient ``UNAVAILABLE``-style errors because the
-    correct responses differ: a fast transient error is worth re-dialing
-    (r4's outage recovered between attempts), but a hang consumes its full
-    180 s per attempt — the r01–r05 records all show the retry loop still
-    sleeping when the harness's own timeout killed the process with rc=124
-    and NO JSON on stdout.  A hanging dial therefore fails FAST with a
-    parseable ``{"error": "backend-dial-timeout"}`` record instead.
-    """
+#: Telemetry of the most recent ``_acquire_backend`` call: total dial
+#: attempts and the per-retry ``{attempt, error, backoff_s}`` records
+#: from the retry policy.  ``main`` embeds this in the structured
+#: failure JSON so a voided round shows exactly what the retry loop did.
+_LAST_DIAL = {"attempts": 0, "retries": []}
 
 
 def _run(global_batch: int, n_steps: int, accum: int = 1,
@@ -252,7 +257,7 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
 
 
 def _acquire_backend(attempts: int = 6, wait_s: float = 75.0):
-    """``jax.devices()`` with retry.
+    """``jax.devices()`` via the shared retry shim.
 
     Round 4's official capture was voided by a single transient
     ``UNAVAILABLE`` raised from backend *initialization* — upstream of
@@ -261,59 +266,40 @@ def _acquire_backend(attempts: int = 6, wait_s: float = 75.0):
     ~30 chip-hours of real work that round), so re-dialing with a backoff
     is the correct response; only after ``attempts`` consecutive failures
     is the error allowed to surface (and ``main`` still turns it into a
-    parseable JSON line).
+    parseable JSON line).  Two fault classes, two responses (both
+    encoded in :func:`diff3d_tpu.runtime.retry.acquire_backend`):
+
+      * a dial that raises fast (``UNAVAILABLE``) is retried with a
+        constant ``wait_s`` backoff, clearing the poisoned client
+        between attempts;
+      * a dial that HANGS past its 180 s SIGALRM budget raises
+        :class:`BackendDialTimeout` immediately — five rounds of records
+        (BENCH_r01..r05) show the harness killing a still-sleeping retry
+        loop (rc=124, no JSON) before it could concede.
+
+    Each call resets ``_LAST_DIAL`` and records attempt/backoff
+    telemetry there for the structured failure JSON.
     """
-    import signal
+    from diff3d_tpu.runtime import retry as _retry
 
-    import jax
+    retries: list = []
+    _LAST_DIAL["attempts"] = 0
+    _LAST_DIAL["retries"] = retries
 
-    def _with_timeout(fn, seconds: int = 180):
-        """Run ``fn()`` under SIGALRM: during the r4 outage the dial
-        didn't raise, it HUNG — a retry loop alone would never get its
-        second attempt.  (Best-effort: a hang inside a C++ call that
-        holds the GIL can't be interrupted; the observed hang is in the
-        RPC wait, which can.)"""
-        def _raise(signum, frame):
-            raise BackendDialTimeout(f"backend dial exceeded {seconds}s")
+    def _notify(attempt, exc, delay):
+        print(f"bench: backend init attempt {attempt}/{attempts} "
+              f"failed: {str(exc).splitlines()[0][:200]}",
+              file=sys.stderr)
 
-        prev = signal.signal(signal.SIGALRM, _raise)
-        signal.alarm(seconds)
-        try:
-            return fn()
-        finally:
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, prev)
-
-    last = None
-    for attempt in range(attempts):
-        try:
-            return _with_timeout(jax.devices)
-        except BackendDialTimeout:
-            # A hang is not a fast fault: each extra attempt costs the
-            # full dial budget + backoff, and five rounds of records
-            # (BENCH_r01..r05) show the harness killing the process
-            # (rc=124, no JSON) before the loop concedes.  Surface it
-            # immediately — main() turns it into the parseable
-            # {"error": "backend-dial-timeout"} record.
-            raise
-        except Exception as e:  # UNAVAILABLE / DEADLINE_EXCEEDED
-            last = e
-            print(f"bench: backend init attempt {attempt + 1}/{attempts} "
-                  f"failed: {str(e).splitlines()[0][:200]}",
-                  file=sys.stderr)
-            try:
-                # Drop the poisoned client so the next jax.devices()
-                # re-dials the backend instead of returning the cached
-                # failure (private API; jax 0.9 has no public equivalent —
-                # guarded so an API move degrades to plain retry).
-                from jax._src import xla_bridge
-
-                xla_bridge._clear_backends()
-            except Exception:
-                pass
-            if attempt < attempts - 1:
-                time.sleep(wait_s)
-    raise last
+    try:
+        devices = _retry.acquire_backend(
+            attempts=attempts, wait_s=wait_s,
+            attempts_log=retries, on_retry=_notify)
+    except BaseException:
+        _LAST_DIAL["attempts"] = len(retries) + 1
+        raise
+    _LAST_DIAL["attempts"] = len(retries) + 1
+    return devices
 
 
 def main() -> int:
@@ -337,6 +323,8 @@ def main() -> int:
             "vs_baseline": None,
             "error": "backend-dial-timeout",
             "detail": str(e).splitlines()[0][:300],
+            "dial": {"attempts": _LAST_DIAL["attempts"],
+                     "retries": list(_LAST_DIAL["retries"])},
         }))
         return 0
     except Exception as e:
@@ -349,6 +337,8 @@ def main() -> int:
             "vs_baseline": None,
             "error": f"backend init failed after retries: "
                      f"{str(e).splitlines()[0][:300]}",
+            "dial": {"attempts": _LAST_DIAL["attempts"],
+                     "retries": list(_LAST_DIAL["retries"])},
         }))
         return 0
 

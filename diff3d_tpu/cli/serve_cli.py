@@ -55,6 +55,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "HTTP 429")
     p.add_argument("--timeout_s", type=float, default=None,
                    help="default per-request deadline")
+    p.add_argument("--watchdog_s", type=float, default=None,
+                   help="watchdog deadline per device step: past it the "
+                        "engine rejects the stuck batch with a retryable "
+                        "error and degrades instead of hanging futures "
+                        "(0 disables)")
+    p.add_argument("--drain_s", type=float, default=10.0,
+                   help="on SIGTERM/SIGINT, stop admitting work and wait "
+                        "up to this long for in-flight requests to finish "
+                        "before stopping (0 = immediate stop)")
     p.add_argument("--steps", type=int, default=None,
                    help="diffusion steps per view (reference: 256)")
     p.add_argument("--scan_chunks", type=int, default=1,
@@ -101,6 +110,8 @@ def build_service(args):
         over["max_wait_ms"] = args.max_wait_ms
     if args.timeout_s is not None:
         over["default_timeout_s"] = args.timeout_s
+    if args.watchdog_s is not None:
+        over["watchdog_timeout_s"] = args.watchdog_s
     if over:
         cfg = dataclasses.replace(
             cfg, serving=dataclasses.replace(cfg.serving, **over))
@@ -166,7 +177,7 @@ def main(argv=None) -> None:
     try:
         done.wait()
     finally:
-        service.stop()
+        service.stop(drain_s=args.drain_s)
         logging.info("stopped")
 
 

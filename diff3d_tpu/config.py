@@ -149,6 +149,12 @@ class TrainConfig:
     # None follows an existing directory marker (resume keeps whatever
     # mode the run started with), defaulting to "full" on fresh dirs.
     ckpt_mode: Optional[str] = None
+    # full_sliced only: snapshot device->host on the training thread,
+    # commit files from a background writer (retry + backoff + atomic
+    # rename), so a slow filesystem no longer stalls the step loop.  The
+    # preemption path still waits on the durability barrier before
+    # exiting.  False = fully synchronous saves (the parity oracle).
+    ckpt_async: bool = True
     grad_clip: float = 0.0            # 0 disables (reference has none)
 
 
@@ -227,6 +233,25 @@ class ServingConfig:
     result_cache_entries: int = 32
     # Per-request view-count ceiling (bounds record capacity / HBM).
     max_views: int = 16
+    # ---- fault tolerance (serving/engine.py watchdog + health) ------
+    # Stuck-step watchdog: a view-step dispatch older than this is
+    # declared stuck — its in-flight requests are failed with a typed
+    # retryable error and the engine degrades.  Generous by default
+    # (srn128 runs ~107 s/view); 0 disables the watchdog.
+    watchdog_timeout_s: float = 600.0
+    # Attempts per view-step dispatch (1 = no retry) and the base
+    # backoff between them.  Inputs are re-stacked host buffers, so a
+    # re-dispatch after a transient backend fault is safe and bit-exact.
+    step_retry_attempts: int = 2
+    step_retry_backoff_s: float = 0.2
+    # Consecutive clean steps required to leave `degraded` for `ok`.
+    degraded_recovery_steps: int = 3
+    # Advisory client wait carried on typed retryable rejections
+    # (HTTP maps it to a Retry-After header).
+    retry_after_s: float = 5.0
+    # Watchdog respawns of a dead engine loop before giving up and
+    # failing new submissions fast.
+    engine_max_restarts: int = 3
 
     def validate(self) -> None:
         if self.max_batch < 1:
@@ -242,6 +267,29 @@ class ServingConfig:
             raise ValueError(
                 f"max_views={self.max_views} must be >= 2 (one "
                 "conditioning view + one target)")
+        if self.watchdog_timeout_s < 0:
+            raise ValueError(
+                f"watchdog_timeout_s={self.watchdog_timeout_s} must be "
+                ">= 0 (0 disables)")
+        if self.step_retry_attempts < 1:
+            raise ValueError(
+                f"step_retry_attempts={self.step_retry_attempts} must be "
+                ">= 1 (1 = no retry)")
+        if self.step_retry_backoff_s < 0:
+            raise ValueError(
+                f"step_retry_backoff_s={self.step_retry_backoff_s} must "
+                "be >= 0")
+        if self.degraded_recovery_steps < 1:
+            raise ValueError(
+                f"degraded_recovery_steps={self.degraded_recovery_steps} "
+                "must be >= 1")
+        if self.retry_after_s <= 0:
+            raise ValueError(
+                f"retry_after_s={self.retry_after_s} must be > 0")
+        if self.engine_max_restarts < 0:
+            raise ValueError(
+                f"engine_max_restarts={self.engine_max_restarts} must be "
+                ">= 0")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -14,23 +14,41 @@ import logging
 
 import jax
 
+from diff3d_tpu.runtime.retry import (RetryPolicy,
+                                      is_transient_backend_error)
+
 log = logging.getLogger(__name__)
+
+#: Coordinator dial retry: at pod bring-up the coordinator process and
+#: the workers race, so the first dial routinely lands before the
+#: coordinator listens (UNAVAILABLE / connection refused).  Only
+#: transient transport faults retry; config errors surface immediately.
+_INIT_RETRY = RetryPolicy(max_attempts=4, base_delay_s=5.0,
+                          max_delay_s=30.0,
+                          classify=is_transient_backend_error)
 
 
 def maybe_initialize_distributed(coordinator_address: str | None = None,
                                  num_processes: int | None = None,
-                                 process_id: int | None = None) -> bool:
+                                 process_id: int | None = None,
+                                 retry: RetryPolicy | None = None) -> bool:
     """Initialise JAX's multi-host runtime if we're in a multi-process job.
 
     MUST run before any other JAX call (``jax.distributed.initialize``
     refuses once a backend exists) — call it first thing in ``main``.
     Single-process environments (no coordinator configured) fall through
     and return False; an already-initialised runtime returns True.
+    Transient coordinator-dial faults (workers racing the coordinator at
+    pod bring-up) are retried under ``retry`` (default: 4 attempts with
+    5-30 s backoff) before surfacing.
     """
+    policy = retry or _INIT_RETRY
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
+        policy.call(
+            lambda: jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id),
+            describe="jax.distributed.initialize")
     except RuntimeError as e:
         # Either already initialised (fine) or initialise-after-backend-use
         # (a real bug in the caller's ordering) — distinguish loudly.

@@ -1,0 +1,20 @@
+"""Cross-cutting runtime services shared by training, serving and the
+benchmark harness.
+
+Today this is the fault-tolerance layer's retry shim
+(:mod:`diff3d_tpu.runtime.retry`): one policy object for "how do we
+classify and survive a transient backend/IO fault" so the trainer, the
+serving engine and ``bench.py`` stop hand-rolling three divergent copies
+of the same failure handling.
+"""
+
+from diff3d_tpu.runtime.retry import (BackendDialTimeout, RetryPolicy,
+                                      RetryableError, acquire_backend,
+                                      is_transient_backend_error,
+                                      is_transient_io_error)
+
+__all__ = [
+    "BackendDialTimeout", "RetryPolicy", "RetryableError",
+    "acquire_backend", "is_transient_backend_error",
+    "is_transient_io_error",
+]

@@ -329,11 +329,12 @@ class Sampler:
           and the rest is the updated record carry for the next view.
           ``record_imgs`` is DONATED: a passed-in device buffer is
           invalidated and the returned one must be used instead (numpy
-          inputs are unaffected — donation of host memory is a no-op).
+          inputs are first copied into an XLA-owned buffer — see
+          :meth:`_owned` — so the caller's array is unaffected).
         """
         p = self.params if params is None else params
         return self._run_view(
-            p, jnp.asarray(record_imgs), jnp.asarray(record_R),
+            p, self._owned(record_imgs), jnp.asarray(record_R),
             jnp.asarray(record_T), jnp.asarray(step, jnp.int32),
             jnp.asarray(K), jnp.asarray(rng))
 
@@ -360,7 +361,7 @@ class Sampler:
                 "use synthesize_many, which pads internally")
         p = self.params if params is None else params
         return self._run_view_many(
-            p, jnp.asarray(record_imgs), jnp.asarray(record_R),
+            p, self._owned(record_imgs), jnp.asarray(record_R),
             jnp.asarray(record_T), jnp.asarray(steps, jnp.int32),
             jnp.asarray(K), jnp.asarray(rngs))
 
@@ -382,9 +383,26 @@ class Sampler:
         record_T[:n_views] = T[:n_views]
         return record_imgs, record_R, record_T
 
+    def _owned(self, x, sharding=None):
+        """XLA-owned device upload of a potentially-donated operand.
+
+        ``jnp.asarray``/``device_put`` may zero-copy ALIAS an aligned
+        numpy buffer (CPU backend); the view-step programs DONATE the
+        record carry, and donating such an alias frees memory the XLA
+        allocator does not own — heap corruption that surfaces far from
+        here.  Host inputs are therefore copied into an XLA-allocated
+        buffer; device arrays pass through untouched, so the
+        steady-state loop still threads donated handles copy-free.
+        """
+        if isinstance(x, jax.Array):
+            return x
+        arr = (jax.device_put(x, sharding)
+               if self.mesh is not None and sharding is not None
+               else jnp.asarray(x))
+        return jnp.copy(arr)
+
     def _put(self, x, sharding):
-        return (jnp.asarray(x) if self.mesh is None
-                else jax.device_put(x, sharding))
+        return self._owned(x, sharding)
 
     def synthesize(self, views: Dict[str, np.ndarray], rng: jax.Array,
                    out_dir: Optional[str] = None,

@@ -12,16 +12,22 @@ and metrics endpoints.
 
 from diff3d_tpu.serving.cache import (ParamsRegistry, ProgramCache,
                                       ResultCache)
-from diff3d_tpu.serving.engine import Engine
+from diff3d_tpu.serving.engine import (Engine, EngineStopTimeout,
+                                       HEALTH_DEGRADED, HEALTH_DRAINING,
+                                       HEALTH_OK)
 from diff3d_tpu.serving.metrics import MetricsRegistry
-from diff3d_tpu.serving.scheduler import (Bucket, QueueFullError,
+from diff3d_tpu.serving.scheduler import (Bucket, EngineDraining,
+                                          EngineOverloaded, EngineStepError,
+                                          EngineStopped, QueueFullError,
                                           RequestCancelled, RequestTimeout,
                                           Scheduler, ViewRequest)
 from diff3d_tpu.serving.server import ServingService, make_http_server
 
 __all__ = [
-    "Bucket", "Engine", "MetricsRegistry", "ParamsRegistry",
-    "ProgramCache", "QueueFullError", "RequestCancelled", "RequestTimeout",
-    "ResultCache", "Scheduler", "ServingService", "ViewRequest",
-    "make_http_server",
+    "Bucket", "Engine", "EngineDraining", "EngineOverloaded",
+    "EngineStepError", "EngineStopTimeout", "EngineStopped",
+    "HEALTH_DEGRADED", "HEALTH_DRAINING", "HEALTH_OK", "MetricsRegistry",
+    "ParamsRegistry", "ProgramCache", "QueueFullError", "RequestCancelled",
+    "RequestTimeout", "ResultCache", "Scheduler", "ServingService",
+    "ViewRequest", "make_http_server",
 ]

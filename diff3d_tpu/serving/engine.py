@@ -52,14 +52,34 @@ import jax
 import numpy as np
 
 from diff3d_tpu.config import ServingConfig
+from diff3d_tpu.runtime.retry import (RetryPolicy,
+                                      is_transient_backend_error)
 from diff3d_tpu.serving.cache import (ParamsRegistry, ProgramCache,
                                       ResultCache)
 from diff3d_tpu.serving.metrics import MetricsRegistry
-from diff3d_tpu.serving.scheduler import (RequestCancelled, RequestTimeout,
+from diff3d_tpu.serving.scheduler import (EngineDraining, EngineOverloaded,
+                                          EngineStepError, EngineStopped,
+                                          RequestCancelled, RequestTimeout,
                                           Scheduler, ViewRequest)
 from diff3d_tpu.utils.profiling import StepTimer
 
 log = logging.getLogger(__name__)
+
+#: Engine health states (DESIGN.md §7).  ``ok`` -> full capacity;
+#: ``degraded`` -> halved batch ceiling, queue soft limit, shed
+#: lower-priority buckets, Retry-After on rejected admissions; returns
+#: to ``ok`` after ``degraded_recovery_steps`` consecutive clean steps.
+#: ``draining`` -> no new admissions, existing work runs to completion.
+HEALTH_OK = "ok"
+HEALTH_DEGRADED = "degraded"
+HEALTH_DRAINING = "draining"
+_HEALTH_GAUGE = {HEALTH_OK: 0, HEALTH_DEGRADED: 1, HEALTH_DRAINING: 2}
+
+
+class EngineStopTimeout(RuntimeError):
+    """``Engine.stop(timeout)`` could not join the worker thread — it is
+    leaked (most likely wedged in a device call).  Operator-facing and
+    NOT retryable: the process needs external attention."""
 
 
 def lane_count(n: int, max_batch: int, multiple: int = 1) -> int:
@@ -161,9 +181,49 @@ class Engine:
         self._fetch_bytes = m.counter(
             "serving_host_fetch_bytes_total",
             "device->host bytes fetched from view-step batches")
+        self._step_faults = m.counter(
+            "serving_engine_step_faults_total",
+            "view-step dispatches that failed after retries")
+        self._watchdog_trips = m.counter(
+            "serving_engine_watchdog_trips_total",
+            "stuck view steps detected by the watchdog")
+        self._restarts_ctr = m.counter(
+            "serving_engine_restarts_total",
+            "engine loop threads respawned after dying")
+        self._stop_timeouts = m.counter(
+            "serving_engine_stop_timeout_total",
+            "stop() calls that leaked the worker thread")
+        self._health_g = m.gauge(
+            "serving_engine_health",
+            "engine health (0=ok, 1=degraded, 2=draining)")
 
         self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
         self._stop = threading.Event()
+
+        # -- fault-tolerance state (DESIGN.md §7) ------------------------
+        # Transient-fault retry around each view-step dispatch.  Inputs
+        # are freshly stacked host buffers, so a re-dispatch is safe and
+        # bit-exact; real compile/shape errors are classified
+        # non-retryable and surface immediately.
+        self.step_policy = RetryPolicy(
+            max_attempts=max(1, cfg.step_retry_attempts),
+            base_delay_s=cfg.step_retry_backoff_s,
+            max_delay_s=max(cfg.step_retry_backoff_s * 8, 1e-9),
+            classify=is_transient_backend_error)
+        self._health = HEALTH_OK
+        self._health_lock = threading.Lock()
+        self._ok_streak = 0          # clean steps since the last fault
+        self._restarts = 0
+        # Admitted-but-unresolved requests, so the watchdog thread can
+        # fail them with typed retryable errors when the loop wedges.
+        # ViewRequest._reject is idempotent under the request's own
+        # lock, so watchdog and loop racing on the same request is safe.
+        self._inflight: dict = {}
+        self._inflight_lock = threading.Lock()
+        # Monotonic deadline of the dispatch currently on device (None
+        # when no dispatch is running); read by the watchdog.
+        self._step_deadline: Optional[float] = None
 
     # -- client surface --------------------------------------------------
 
@@ -188,33 +248,222 @@ class Engine:
                                         name="diff3d-serving-engine",
                                         daemon=True)
         self._thread.start()
+        if self.cfg.watchdog_timeout_s > 0 and self._watchdog is None:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name="diff3d-serving-watchdog", daemon=True)
+            self._watchdog.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
+        """Stop the engine, joining the worker within ``timeout``.
+
+        A worker that fails to exit (wedged in a device call) is a
+        LEAKED thread: the ``serving_engine_stop_timeout_total`` counter
+        is bumped and :class:`EngineStopTimeout` is raised so the
+        condition is impossible to miss — the old behavior of silently
+        returning left operators believing the replica had shut down.
+        """
         self._stop.set()
         self.scheduler.close(reject_pending=True)
-        if self._thread is not None:
-            self._thread.join(timeout)
-            self._thread = None
+        thread, self._thread = self._thread, None
+        watchdog, self._watchdog = self._watchdog, None
+        if watchdog is not None:
+            watchdog.join(timeout=5.0)
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                self._stop_timeouts.inc()
+                self._reject_inflight(EngineStopped(
+                    "engine stopped with the worker thread wedged"))
+                raise EngineStopTimeout(
+                    f"engine worker {thread.name!r} did not exit within "
+                    f"{timeout}s — thread leaked (likely wedged in a "
+                    "device call)")
+
+    def drain(self, timeout: Optional[float] = 30.0,
+              poll_s: float = 0.05) -> bool:
+        """Graceful rollout/shutdown: stop admitting, finish everything.
+
+        Health moves to ``draining`` and new submissions are rejected
+        with :class:`EngineDraining` (clients resubmit elsewhere, after
+        ``retry_after_s``).  Blocks until the queue and all in-flight
+        work are resolved, up to ``timeout`` (None = wait forever).
+        Returns True once empty; the caller then calls :meth:`stop`.
+        """
+        self._set_health(HEALTH_DRAINING)
+        self.scheduler.freeze(lambda: EngineDraining(
+            "replica draining for shutdown/rollout: retry elsewhere",
+            retry_after_s=self.cfg.retry_after_s))
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while self.scheduler.depth() or self._inflight_count():
+            if not self.alive:
+                break            # nothing will make progress; report below
+            if deadline is not None and time.monotonic() > deadline:
+                log.warning(
+                    "drain timed out with %d queued / %d in flight",
+                    self.scheduler.depth(), self._inflight_count())
+                return False
+            time.sleep(poll_s)
+        drained = not (self.scheduler.depth() or self._inflight_count())
+        log.info("drain complete" if drained else "drain incomplete")
+        return drained
 
     @property
     def alive(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def health(self) -> str:
+        return self._health
 
     def snapshot_extra(self) -> dict:
         """Engine-level details merged into the metrics snapshot."""
         return {
             "engine": {
                 "alive": self.alive,
+                "health": self._health,
+                "restarts": self._restarts,
                 "params_version": self.registry.version,
                 "lane_multiple": self.lane_multiple,
                 "max_batch": self.max_batch,
+                "effective_max_batch": self._effective_max_batch(),
                 "num_devices": jax.device_count(),
                 "step_timer": self.step_timer.summary(),
                 "program_cache": self.programs.stats(),
                 "result_cache_entries": len(self.result_cache),
             }
         }
+
+    # -- health machinery ------------------------------------------------
+
+    def _set_health(self, state: str) -> None:
+        with self._health_lock:
+            if self._health == state:
+                return
+            log.warning("engine health: %s -> %s", self._health, state)
+            self._health = state
+            self._health_g.set(_HEALTH_GAUGE[state])
+
+    def _effective_max_batch(self) -> int:
+        """Batch ceiling under the current health: degraded mode halves
+        it (rounded up to the mesh quantum) to cut blast radius while
+        the fault source is live."""
+        if self._health != HEALTH_DEGRADED:
+            return self.max_batch
+        half = max(1, self.max_batch // 2)
+        half = -(-half // self.lane_multiple) * self.lane_multiple
+        return min(half, self.max_batch)
+
+    def _note_fault(self, reason: str) -> None:
+        """A step failed or stuck: degrade (unless draining) and shed."""
+        self._step_faults.inc()
+        with self._health_lock:
+            self._ok_streak = 0
+            draining = self._health == HEALTH_DRAINING
+            was_ok = self._health == HEALTH_OK
+        if draining or not was_ok:
+            return
+        self._set_health(HEALTH_DEGRADED)
+        shed = self.scheduler.shed(
+            lambda req: EngineOverloaded(
+                f"{req.id}: shed while replica degrades ({reason}); "
+                "retry later",
+                retry_after_s=self.cfg.retry_after_s))
+        self.scheduler.set_soft_limit(
+            max(1, self.scheduler.max_queue // 4),
+            lambda: EngineOverloaded(
+                "replica degraded: admission reduced; retry later",
+                retry_after_s=self.cfg.retry_after_s))
+        log.warning("engine degraded (%s); shed %d queued requests",
+                    reason, shed)
+
+    def _note_step_ok(self) -> None:
+        with self._health_lock:
+            degraded = self._health == HEALTH_DEGRADED
+            if degraded:
+                self._ok_streak += 1
+                recovered = (self._ok_streak
+                             >= self.cfg.degraded_recovery_steps)
+            else:
+                recovered = False
+        if recovered:
+            self.scheduler.clear_soft_limit()
+            self._set_health(HEALTH_OK)
+            log.info("engine recovered: %d consecutive clean steps",
+                     self.cfg.degraded_recovery_steps)
+
+    # -- in-flight registry (shared with the watchdog) -------------------
+
+    def _register(self, req: ViewRequest) -> None:
+        with self._inflight_lock:
+            self._inflight[req.id] = req
+
+    def _unregister(self, req: ViewRequest) -> None:
+        with self._inflight_lock:
+            self._inflight.pop(req.id, None)
+
+    def _inflight_count(self) -> int:
+        with self._inflight_lock:
+            return len(self._inflight)
+
+    def _reject_inflight(self, exc: BaseException) -> int:
+        with self._inflight_lock:
+            reqs, self._inflight = list(self._inflight.values()), {}
+        for req in reqs:
+            self._failed.inc()
+            req._reject(exc)
+        return len(reqs)
+
+    # -- watchdog --------------------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Detect a stuck dispatch or a dead loop thread and keep the
+        replica's contract: every admitted request resolves, with a
+        typed retryable error if nothing better is possible."""
+        poll = max(0.05, min(0.25, self.cfg.watchdog_timeout_s / 4.0))
+        while not self._stop.wait(poll):
+            deadline = self._step_deadline
+            if deadline is not None and time.monotonic() > deadline:
+                # The dispatch has been on device longer than the step
+                # budget.  Clear the deadline first so one stuck step
+                # trips once, not every poll.
+                self._step_deadline = None
+                self._watchdog_trips.inc()
+                n = self._reject_inflight(EngineStepError(
+                    f"view step stuck > {self.cfg.watchdog_timeout_s}s "
+                    "(watchdog); retry later",
+                    retry_after_s=self.cfg.retry_after_s))
+                log.error("watchdog: stuck view step; failed %d "
+                          "in-flight requests", n)
+                self._note_fault("stuck view step")
+            thread = self._thread
+            if (thread is not None and not thread.is_alive()
+                    and not self._stop.is_set()):
+                n = self._reject_inflight(EngineStepError(
+                    "engine loop died; retry later",
+                    retry_after_s=self.cfg.retry_after_s))
+                self._note_fault("engine loop died")
+                if self._restarts < self.cfg.engine_max_restarts:
+                    self._restarts += 1
+                    self._restarts_ctr.inc()
+                    log.error(
+                        "watchdog: engine loop died (%d in flight); "
+                        "respawning (restart %d/%d)", n, self._restarts,
+                        self.cfg.engine_max_restarts)
+                    self._thread = threading.Thread(
+                        target=self._loop, name="diff3d-serving-engine",
+                        daemon=True)
+                    self._thread.start()
+                else:
+                    log.critical(
+                        "watchdog: engine loop died and the restart "
+                        "budget (%d) is exhausted; failing fast",
+                        self.cfg.engine_max_restarts)
+                    self.scheduler.freeze(lambda: EngineStopped(
+                        "engine loop dead (restart budget exhausted)"))
+                    return           # nothing left to watch
 
     # -- executor loop ---------------------------------------------------
 
@@ -228,31 +477,50 @@ class Engine:
                 try:
                     self._run_view_step(active)
                 except Exception as e:   # resolve, don't kill the server
-                    log.exception("view step failed")
+                    log.exception("view step failed (after retries)")
+                    self._note_fault(str(e).splitlines()[0][:120]
+                                     if str(e) else type(e).__name__)
                     for slot in active:
                         self._failed.inc()
-                        slot.req._reject(e)
+                        self._unregister(slot.req)
+                        slot.req._reject(EngineStepError(
+                            f"{slot.req.id}: view step failed ({e}); "
+                            "retry later",
+                            retry_after_s=self.cfg.retry_after_s))
                     active = []
+                    self._active_g.set(0)
                     continue
+                self._note_step_ok()
                 active = self._retire(active)
         finally:
             for slot in active:
-                slot.req._reject(RuntimeError("engine stopped"))
+                self._unregister(slot.req)
+                slot.req._reject(EngineStopped(
+                    f"{slot.req.id}: engine stopped"))
             self._active_g.set(0)
 
     def _admit(self, active: List[_Slot]) -> List[_Slot]:
-        free = self.max_batch - len(active)
+        # Drop slots whose request was resolved out from under the loop
+        # (watchdog rejection, client cancel racing completion).
+        done = [s for s in active if s.req.done()]
+        if done:
+            for slot in done:
+                self._unregister(slot.req)
+            active = [s for s in active if not s.req.done()]
+        limit = self._effective_max_batch()
+        free = limit - len(active)
         if active:
             got = self.scheduler.acquire(active[0].req.bucket, free,
                                          block=False) if free > 0 else []
         else:
-            got = self.scheduler.acquire(None, self.max_batch,
+            got = self.scheduler.acquire(None, limit,
                                          block=True, poll_s=0.2)
         now = time.monotonic()
         for req in got:
             self._queue_wait.observe(now - req.submit_time)
+            self._register(req)
             active.append(_Slot(req, self.guidance_B))
-        if got or not active:
+        if got or done or not active:
             self._active_g.set(len(active))
         return active
 
@@ -280,11 +548,25 @@ class Engine:
         version, params = self.registry.current()
         bucket = active[0].req.bucket
         t0 = time.monotonic()
-        out, _, _, new_rngs = self.programs.step_many(
-            bucket, lanes, record_imgs, record_R, record_T, steps, Ks,
-            rngs, params=params)
-        out = np.asarray(jax.block_until_ready(out))
-        new_rngs = np.asarray(new_rngs)
+
+        def _dispatch():
+            # Arm the watchdog per attempt: a retry gets a fresh step
+            # budget, and the deadline is cleared even on failure so the
+            # backoff sleep can't be mistaken for a stuck device.
+            if self.cfg.watchdog_timeout_s > 0:
+                self._step_deadline = (time.monotonic()
+                                       + self.cfg.watchdog_timeout_s)
+            try:
+                r = self.programs.step_many(
+                    bucket, lanes, record_imgs, record_R, record_T,
+                    steps, Ks, rngs, params=params)
+                return (np.asarray(jax.block_until_ready(r[0])),
+                        np.asarray(r[3]))
+            finally:
+                self._step_deadline = None
+
+        out, new_rngs = self.step_policy.call(
+            _dispatch, describe=f"view step {bucket}")
         dt = time.monotonic() - t0
         self._fetch_bytes.inc(out.nbytes + new_rngs.nbytes)
         self.step_timer.tick()
@@ -312,6 +594,9 @@ class Engine:
         now = time.monotonic()
         for slot in active:
             req = slot.req
+            if req.done():            # resolved elsewhere (watchdog/cancel)
+                self._unregister(req)
+                continue
             if req.cancelled:
                 self._failed.inc()
                 req._reject(RequestCancelled(f"{req.id}: cancelled"))
@@ -330,6 +615,8 @@ class Engine:
                 req._resolve(result)
             else:
                 still.append(slot)
+                continue
+            self._unregister(req)     # resolved or rejected above
         if len(still) != len(active):
             self._active_g.set(len(still))
         return still

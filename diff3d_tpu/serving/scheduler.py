@@ -27,10 +27,11 @@ import itertools
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Deque, Dict, List, NamedTuple, Optional
+from typing import Callable, Deque, Dict, List, NamedTuple, Optional
 
 import numpy as np
 
+from diff3d_tpu.runtime.retry import RetryableError
 from diff3d_tpu.sampling import record_capacity
 
 
@@ -52,6 +53,29 @@ class RequestTimeout(RuntimeError):
 
 class RequestCancelled(RuntimeError):
     """Request was cancelled by the client before completion."""
+
+
+# Typed retryable rejections (see diff3d_tpu/runtime/retry.py): the
+# request did not fail on its own merits — the *replica* faulted, shed,
+# or is going away — so the client (or a future multi-replica router)
+# should retry it elsewhere or after `retry_after_s`.
+
+class EngineStepError(RetryableError):
+    """A view step failed or stuck; in-flight requests were resolved
+    with this instead of hanging their futures."""
+
+
+class EngineOverloaded(RetryableError):
+    """Degraded-mode admission control: shed or rejected to protect the
+    replica while it recovers."""
+
+
+class EngineDraining(RetryableError):
+    """Replica is draining for shutdown/rollout; resubmit elsewhere."""
+
+
+class EngineStopped(RetryableError):
+    """Replica stopped before the request could run."""
 
 
 _req_ids = itertools.count()
@@ -200,6 +224,13 @@ class Scheduler:
         self._pending: "OrderedDict[Bucket, Deque[ViewRequest]]" = \
             OrderedDict()
         self._closed = False
+        # Fault-tolerance admission policy (set by the engine): when
+        # frozen, every submission is rejected with the factory's typed
+        # error (drain mode / dead engine); a soft limit rejects
+        # submissions beyond a reduced depth while degraded.
+        self._frozen: Optional[Callable[[], BaseException]] = None
+        self._soft_limit: Optional[int] = None
+        self._soft_exc: Optional[Callable[[], BaseException]] = None
         m = metrics
         self._depth_gauge = m.gauge(
             "serving_queue_depth",
@@ -210,6 +241,10 @@ class Scheduler:
         self._rejects = m.counter(
             "serving_requests_rejected_total",
             "submissions rejected by the bounded queue") if m else None
+        self._shed = m.counter(
+            "serving_requests_shed_total",
+            "pending requests shed by degraded/drain admission control"
+        ) if m else None
 
     # -- producer side --------------------------------------------------
 
@@ -217,6 +252,17 @@ class Scheduler:
         with self._lock:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
+            if self._frozen is not None:
+                if self._rejects:
+                    self._rejects.inc()
+                raise self._frozen()
+            if (self._soft_limit is not None
+                    and self._depth_locked() >= self._soft_limit):
+                if self._rejects:
+                    self._rejects.inc()
+                raise (self._soft_exc() if self._soft_exc is not None
+                       else EngineOverloaded(
+                           "replica degraded: queue soft limit reached"))
             if self._depth_locked() >= self.max_queue:
                 if self._rejects:
                     self._rejects.inc()
@@ -299,6 +345,56 @@ class Scheduler:
         with self._lock:
             return self._depth_locked()
 
+    # -- fault-tolerance admission control (engine side) -----------------
+
+    def freeze(self, exc_factory: Callable[[], BaseException]) -> None:
+        """Reject all new submissions with ``exc_factory()`` (drain mode,
+        dead engine).  Pending/in-flight work keeps running."""
+        with self._lock:
+            self._frozen = exc_factory
+
+    def unfreeze(self) -> None:
+        with self._lock:
+            self._frozen = None
+
+    def set_soft_limit(self, limit: int,
+                       exc_factory: Optional[Callable[[], BaseException]]
+                       = None) -> None:
+        """Degraded-mode admission: reject submissions once the queue
+        holds ``limit`` requests (below ``max_queue``)."""
+        with self._lock:
+            self._soft_limit = max(1, int(limit))
+            self._soft_exc = exc_factory
+
+    def clear_soft_limit(self) -> None:
+        with self._lock:
+            self._soft_limit = None
+            self._soft_exc = None
+
+    def shed(self, exc_factory: Callable[[ViewRequest], BaseException],
+             keep_oldest: bool = True) -> int:
+        """Reject pending requests to cut load on a degraded replica.
+
+        Priority is age: the bucket holding the *oldest* pending request
+        (the next one the engine would serve) is kept; every other
+        bucket's requests are resolved with ``exc_factory(req)`` — a
+        typed retryable error, so clients know to go elsewhere.  Returns
+        the number shed.
+        """
+        n = 0
+        with self._lock:
+            keep = self._oldest_bucket_locked() if keep_oldest else None
+            for b in list(self._pending):
+                if b == keep:
+                    continue
+                for req in self._pending.pop(b):
+                    req._reject(exc_factory(req))
+                    n += 1
+                    if self._shed:
+                        self._shed.inc()
+            self._update_depth()
+        return n
+
     def close(self, reject_pending: bool = True) -> None:
         """Stop accepting work; optionally reject everything queued."""
         with self._lock:
@@ -306,7 +402,8 @@ class Scheduler:
             if reject_pending:
                 for q in self._pending.values():
                     for req in q:
-                        req._reject(RuntimeError("server shutting down"))
+                        req._reject(EngineStopped(
+                            f"{req.id}: server shutting down"))
                 self._pending.clear()
             self._update_depth()
             self._nonempty.notify_all()

@@ -34,6 +34,7 @@ from urllib.parse import urlparse
 import numpy as np
 
 from diff3d_tpu.config import Config
+from diff3d_tpu.runtime.retry import RetryableError
 from diff3d_tpu.serving.cache import ParamsRegistry, ProgramCache, ResultCache
 from diff3d_tpu.serving.engine import Engine
 from diff3d_tpu.serving.metrics import MetricsRegistry
@@ -51,9 +52,18 @@ def _error_status(exc: BaseException) -> int:
         return 504
     if isinstance(exc, RequestCancelled):
         return 409
+    if isinstance(exc, RetryableError):
+        # Typed retryable rejection (degraded/draining/step fault): the
+        # replica, not the request, is the problem — 503 + Retry-After.
+        return 503
     if isinstance(exc, (ValueError, KeyError, TypeError)):
         return 400
     return 500
+
+
+def _retry_after(exc: BaseException) -> Optional[int]:
+    after = getattr(exc, "retry_after_s", None)
+    return max(1, int(round(after))) if after else None
 
 
 class ServingService:
@@ -98,12 +108,21 @@ class ServingService:
             self._http_thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 0.0) -> None:
+        """Shut the service down; ``drain_s > 0`` first drains the
+        engine (no new admissions, in-flight work finishes) for up to
+        that many seconds — the clean-rollout path."""
+        if drain_s > 0 and self.engine.alive:
+            self.engine.drain(timeout=drain_s)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
         self.engine.stop()
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        """Stop admissions and wait for queued + in-flight work."""
+        return self.engine.drain(timeout=timeout)
 
     @property
     def port(self) -> Optional[int]:
@@ -165,10 +184,15 @@ class ServingService:
         }
 
     def health(self) -> dict:
-        ok = self.engine.alive
+        alive = self.engine.alive
+        # Engine health states (ok|degraded|draining, DESIGN.md §7); a
+        # dead engine thread reports degraded whatever the state says.
+        status = self.engine.health if alive else "degraded"
         return {
-            "status": "ok" if ok else "degraded",
-            "engine_alive": ok,
+            "status": status,
+            "engine_alive": alive,
+            "engine_health": self.engine.health,
+            "engine_restarts": self.engine._restarts,
             "queue_depth": self.scheduler.depth(),
             "params_version": self.registry.version,
             "lane_multiple": self.engine.lane_multiple,
@@ -190,11 +214,14 @@ def make_http_server(service: ServingService, host: str,
         def log_message(self, fmt, *args):   # route through logging, not
             log.debug("%s " + fmt, self.address_string(), *args)  # stderr
 
-        def _send_json(self, status: int, obj: dict) -> None:
+        def _send_json(self, status: int, obj: dict,
+                       retry_after: Optional[int] = None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after is not None:
+                self.send_header("Retry-After", str(retry_after))
             self.end_headers()
             self.wfile.write(body)
 
@@ -227,7 +254,8 @@ def make_http_server(service: ServingService, host: str,
                 elif req.error is not None:
                     self._send_json(_error_status(req.error),
                                     {"id": req.id,
-                                     "error": str(req.error)})
+                                     "error": str(req.error)},
+                                    retry_after=_retry_after(req.error))
                 else:
                     self._send_json(200, service.result_payload(req))
             else:
@@ -243,7 +271,8 @@ def make_http_server(service: ServingService, host: str,
                 payload = json.loads(self.rfile.read(length) or b"{}")
                 req = service.submit(payload)
             except Exception as e:
-                self._send_json(_error_status(e), {"error": str(e)})
+                self._send_json(_error_status(e), {"error": str(e)},
+                                retry_after=_retry_after(e))
                 return
             if not payload.get("block", True):
                 self._send_json(202, {"id": req.id, "status": "pending"})
@@ -256,7 +285,8 @@ def make_http_server(service: ServingService, host: str,
                 self._send_json(200, service.result_payload(req))
             except Exception as e:
                 self._send_json(_error_status(e),
-                                {"id": req.id, "error": str(e)})
+                                {"id": req.id, "error": str(e)},
+                                retry_after=_retry_after(e))
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.daemon_threads = True

@@ -1,0 +1,13 @@
+"""Test-support utilities shipped with the package.
+
+:mod:`diff3d_tpu.testing.faults` is the deterministic fault-injection
+harness behind the chaos suite (``pytest -m chaos``) and
+``tools/chaos_serving.py``.  It lives in the package (not ``tests/``)
+so the soak tool and downstream users can inject faults against a real
+engine without importing test code.
+"""
+
+from diff3d_tpu.testing.faults import (FaultInjected, FaultInjector,
+                                       FaultSpec, wrap_sampler)
+
+__all__ = ["FaultInjected", "FaultInjector", "FaultSpec", "wrap_sampler"]

@@ -35,12 +35,14 @@ marker existed were full).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import os
+import queue
 import shutil
-import time
-from typing import Optional, Tuple
+import threading
+from typing import Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +50,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from diff3d_tpu.parallel.multihost import is_primary
+from diff3d_tpu.runtime.retry import RetryPolicy, is_transient_io_error
 from diff3d_tpu.train.state import TrainState
 
 log = logging.getLogger(__name__)
@@ -56,17 +59,71 @@ _MARKER = "ckpt_format.json"
 _SLICED_MANIFEST = "sliced_manifest.json"
 MODES = ("full", "ema_bf16", "full_sliced")
 
+#: Per-leaf device->host fetch retry for sliced saves.  Any exception is
+#: retried (matching the historical behavior: a transient link fault
+#: costs one leaf's retry, not the whole save); the delays mirror the
+#: old hand-rolled 5s/10s schedule.
+_DEFAULT_FETCH_RETRY = RetryPolicy(
+    max_attempts=3, base_delay_s=5.0, max_delay_s=10.0, growth=2.0,
+    jitter=0.0, classify=lambda exc: True)
+
+#: Commit retry for the async writer: exponential backoff + jitter over
+#: filesystem faults.  The commit rebuilds its tmp dir from the host
+#: snapshot on every attempt, so a half-written tmp tree from a failed
+#: attempt is simply clobbered.
+_DEFAULT_WRITE_RETRY = RetryPolicy(
+    max_attempts=4, base_delay_s=0.5, max_delay_s=8.0, growth=2.0,
+    jitter=0.25, classify=is_transient_io_error)
+
+
+@dataclasses.dataclass
+class _SlicedSnapshot:
+    """A fully host-resident copy of one TrainState, ready to write.
+
+    Built on the *training* thread (device->host fetches must not race
+    the train step's donated buffers); consumed by the writer thread,
+    which touches only these numpy arrays and the filesystem.
+    """
+
+    step: int
+    arrays: List[np.ndarray]     # bf16 already re-viewed as uint16
+    manifest: dict
+
 
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3,
                  save_interval_steps: int | None = None,
-                 mode: str | None = None):
+                 mode: str | None = None,
+                 async_writes: bool = False,
+                 max_inflight_saves: int = 2,
+                 write_retry: RetryPolicy | None = None,
+                 fetch_retry: RetryPolicy | None = None,
+                 fault_hook: Callable[[str], None] | None = None):
         """``mode=None`` (readers, resume-without-flag) follows the
         directory's ``ckpt_format.json`` marker, defaulting to "full" on
         an unmarked directory.  An explicit mode must AGREE with an
         existing marker — silently overriding in either direction would
         either mislabel full checkpoints or quietly discard the user's
-        exact-resume request."""
+        exact-resume request.
+
+        ``async_writes`` applies to ``full_sliced`` only (the Orbax
+        modes are already async): :meth:`save` snapshots device->host on
+        the calling thread, then a background writer commits the files
+        with retry/backoff.  At most ``max_inflight_saves`` snapshots are
+        queued — beyond that :meth:`save` blocks (backpressure, bounding
+        host RAM at ``max_inflight_saves`` extra TrainState copies).  A
+        write failure that survives ``write_retry`` surfaces at the next
+        :meth:`save` or at the :meth:`wait_until_finished` durability
+        barrier, never silently.  The written directory layout is
+        byte-identical to a sync save — restore is shared and the sync
+        path (``async_writes=False``) stays available as the parity
+        oracle.
+
+        ``fault_hook`` is a testing seam (see
+        :mod:`diff3d_tpu.testing.faults`): called with a site name
+        (``"snapshot"``, ``"write"``, ``"commit"``) at each sliced-save
+        IO point so chaos tests can inject failures deterministically.
+        """
         if mode is not None and mode not in MODES:
             raise ValueError(f"mode={mode!r} not in {MODES}")
         self._dir = os.path.abspath(directory)
@@ -86,6 +143,16 @@ class CheckpointManager:
         else:
             self.mode = mode or "full"
         self._keep = keep
+        self._fire = fault_hook or (lambda site: None)
+        self._fetch_retry = fetch_retry or _DEFAULT_FETCH_RETRY
+        self._write_retry = write_retry or _DEFAULT_WRITE_RETRY
+        self._async = bool(async_writes) and self.mode == "full_sliced"
+        self._async_lock = threading.Lock()
+        self._async_error: BaseException | None = None
+        self._pending_steps: set[int] = set()
+        self._queue: queue.Queue = queue.Queue()
+        self._inflight_sem = threading.Semaphore(max(1, max_inflight_saves))
+        self._writer: threading.Thread | None = None
         if self.mode == "full_sliced":
             # No Orbax involvement: saves are plain per-leaf .npy files
             # under <dir>/<step>/ with an atomic-rename commit.  The
@@ -146,43 +213,119 @@ class CheckpointManager:
             if d.isdigit() and os.path.exists(
                 os.path.join(self._dir, d, _SLICED_MANIFEST)))
 
-    def _save_sliced(self, state: TrainState, force: bool = False) -> bool:
+    def _snapshot_sliced(self, state: TrainState) -> _SlicedSnapshot:
+        """Device->host copy of every leaf, on the calling thread.
+
+        Must run on the training thread: the train step donates its
+        input state, so fetching from a background thread would race
+        buffer donation.  Holds one full host copy of the state (the
+        price of decoupling the writer from the training loop).
+        """
+        self._fire("snapshot")
         step = int(jax.device_get(state.step))
-        if not force and step % self._save_interval:
-            return False       # same gating Orbax applies in managed modes
-        final = os.path.join(self._dir, str(step))
-        if os.path.exists(final):
-            return False
-        tmp = final + ".tmp"
-        shutil.rmtree(tmp, ignore_errors=True)
-        os.makedirs(tmp)
         leaves, _ = jax.tree_util.tree_flatten(state)
+        arrays: List[np.ndarray] = []
         manifest = {"step": step, "leaves": []}
         for i, leaf in enumerate(leaves):
-            for attempt in range(3):
-                try:
-                    arr = np.asarray(jax.device_get(leaf))
-                    break
-                except Exception as e:   # transient link fault: one leaf
-                    if attempt == 2:     # retries, not the whole save
-                        raise
-                    log.warning(
-                        "sliced save: leaf %d fetch failed (%s); retrying",
-                        i, str(e).splitlines()[0][:120])
-                    time.sleep(5.0 * (attempt + 1))
+            def _fetch(leaf=leaf):
+                # MUST be an owned copy: device_get may return a
+                # zero-copy VIEW of the live device buffer (CPU
+                # backend), and the training loop DONATES the state to
+                # the next step — an async writer serializing that view
+                # would read freed/reused memory.
+                return np.array(jax.device_get(leaf), copy=True)
+            arr = self._fetch_retry.call(
+                _fetch, describe=f"sliced save: leaf {i} fetch")
             dtype = str(arr.dtype)       # ml_dtypes name, e.g. 'bfloat16'
             if dtype == "bfloat16":      # np.save can't round-trip bf16
                 arr = arr.view(np.uint16)
-            np.save(os.path.join(tmp, f"leaf_{i:05d}.npy"), arr)
+            arrays.append(arr)
             manifest["leaves"].append(
                 {"dtype": dtype, "shape": list(arr.shape)})
+        return _SlicedSnapshot(step=step, arrays=arrays, manifest=manifest)
+
+    def _commit_sliced(self, snap: _SlicedSnapshot) -> None:
+        """Write one snapshot to disk and atomically publish it.
+
+        Pure filesystem work over host arrays — safe on any thread, and
+        safe to retry: each attempt rebuilds the tmp dir from scratch,
+        so a half-written tree from a failed attempt is clobbered and
+        readers only ever see the atomic ``os.replace`` result.
+        """
+        final = os.path.join(self._dir, str(snap.step))
+        if os.path.exists(final):
+            return
+        tmp = final + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        for i, arr in enumerate(snap.arrays):
+            self._fire("write")
+            np.save(os.path.join(tmp, f"leaf_{i:05d}.npy"), arr)
         with open(os.path.join(tmp, _SLICED_MANIFEST), "w") as f:
-            json.dump(manifest, f)
+            json.dump(snap.manifest, f)
+        self._fire("commit")
         os.replace(tmp, final)           # commit: readers never see partial
         if self._keep and self._keep > 0:   # keep<=0 means keep-all
             for old in self._sliced_steps()[: -self._keep]:
                 shutil.rmtree(os.path.join(self._dir, str(old)),
                               ignore_errors=True)
+
+    def _writer_loop(self) -> None:
+        while True:
+            snap = self._queue.get()
+            if snap is None:
+                self._queue.task_done()
+                return
+            try:
+                self._write_retry.call(
+                    lambda: self._commit_sliced(snap),
+                    describe=f"async ckpt commit (step {snap.step})")
+            except BaseException as e:
+                # Surfaced at the next save() or wait_until_finished():
+                # a durability failure must reach the training loop, not
+                # die with this thread.
+                log.exception(
+                    "async checkpoint commit failed permanently (step %d)",
+                    snap.step)
+                with self._async_lock:
+                    self._async_error = e
+            finally:
+                with self._async_lock:
+                    self._pending_steps.discard(snap.step)
+                self._inflight_sem.release()
+                self._queue.task_done()
+
+    def _raise_deferred_error(self) -> None:
+        with self._async_lock:
+            err, self._async_error = self._async_error, None
+        if err is not None:
+            raise err
+
+    def _save_sliced(self, state: TrainState, force: bool = False) -> bool:
+        # A previously failed async save surfaces here, before new work:
+        # durable checkpointing being broken must halt the run, not pass
+        # silently while checkpoints quietly stop landing.
+        self._raise_deferred_error()
+        step = int(jax.device_get(state.step))
+        if not force and step % self._save_interval:
+            return False       # same gating Orbax applies in managed modes
+        with self._async_lock:
+            pending = step in self._pending_steps
+        if pending or os.path.exists(os.path.join(self._dir, str(step))):
+            return False
+        snap = self._snapshot_sliced(state)
+        if not self._async:
+            self._commit_sliced(snap)    # sync parity oracle
+            return True
+        if self._writer is None:
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="diff3d-ckpt-writer",
+                daemon=True)
+            self._writer.start()
+        with self._async_lock:
+            self._pending_steps.add(step)
+        self._inflight_sem.acquire()     # backpressure: bounded in-flight
+        self._queue.put(snap)
         return True
 
     def _restore_sliced(self, abstract_state: TrainState,
@@ -229,6 +372,12 @@ class CheckpointManager:
                 arr = jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)
             else:
                 arr = jnp.asarray(arr)
+            # jnp.asarray may zero-copy ALIAS the freshly-loaded numpy
+            # buffer (CPU backend, alignment permitting).  Restored
+            # leaves feed a donating jit, and donation frees through the
+            # XLA allocator — freeing an aliased numpy buffer corrupts
+            # the heap.  jnp.copy lands the leaf in an XLA-owned buffer.
+            arr = jnp.copy(arr)
             sharding = getattr(sds, "sharding", None)
             out.append(jax.device_put(arr, sharding)
                        if sharding is not None else arr)
@@ -313,10 +462,31 @@ class CheckpointManager:
             abstract_params)
         return int(restored["step"]), ema
 
-    def wait(self) -> None:
-        if self._mgr is not None:       # sliced saves are synchronous
+    def wait_until_finished(self) -> None:
+        """Durability barrier: returns only once every accepted save is
+        committed on disk, raising any deferred write failure.
+
+        The preemption path depends on this contract — "saved then
+        exited" must mean the checkpoint actually landed, for async
+        saves exactly as for sync ones.
+        """
+        if self._mgr is not None:
             self._mgr.wait_until_finished()
+            return
+        if self._writer is not None:
+            self._queue.join()
+        self._raise_deferred_error()
+
+    def wait(self) -> None:
+        self.wait_until_finished()
 
     def close(self) -> None:
         if self._mgr is not None:
             self._mgr.close()
+            return
+        if self._writer is not None:
+            self._queue.put(None)        # sentinel: drain then exit
+            self._writer.join(timeout=60.0)
+            if self._writer.is_alive():  # pragma: no cover - stuck disk
+                log.error("checkpoint writer did not exit within 60s")
+            self._writer = None

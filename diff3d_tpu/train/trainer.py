@@ -30,11 +30,22 @@ from diff3d_tpu.diffusion import p_losses
 from diff3d_tpu.models import XUNet
 from diff3d_tpu.parallel import MeshEnv, make_mesh
 from diff3d_tpu.parallel.multihost import is_primary
+from diff3d_tpu.runtime.retry import (RetryPolicy,
+                                      is_transient_backend_error)
 from diff3d_tpu.train.checkpoint import CheckpointManager
 from diff3d_tpu.train.state import TrainState, create_train_state
 from diff3d_tpu.train.step import make_train_step
 
 log = logging.getLogger(__name__)
+
+#: Retry around each compiled-step dispatch.  Only errors the shared
+#: classifier calls transient (UNAVAILABLE, connection resets, ...) are
+#: retried — those surface at dispatch, before the donated input buffers
+#: are consumed.  A real execution failure is non-retryable and
+#: propagates to the emergency-checkpoint path.
+_STEP_RETRY = RetryPolicy(max_attempts=3, base_delay_s=5.0,
+                          max_delay_s=30.0,
+                          classify=is_transient_backend_error)
 
 
 def init_params(model: XUNet, cfg: Config, rng: jax.Array):
@@ -84,7 +95,8 @@ class Trainer:
         self.ckpt = CheckpointManager(
             os.path.join(workdir, cfg.train.checkpoint_dir),
             keep=cfg.train.keep_checkpoints,
-            mode=cfg.train.ckpt_mode)
+            mode=cfg.train.ckpt_mode,
+            async_writes=cfg.train.ckpt_async)
         if transfer:
             if self.ckpt.mode == "ema_bf16":
                 # Warm restart: EMA-only checkpoints carry no optimizer
@@ -111,23 +123,38 @@ class Trainer:
             else:
                 restored = self.ckpt.restore(self._abstract_state())
                 if restored is not None:
-                    self.state = restored
+                    # Re-place on the mesh policy: restore() hands back
+                    # single-device arrays (full_sliced leaves may even
+                    # alias the loader's host buffers), and the donating
+                    # sharded step must only ever see jax-owned buffers
+                    # laid out like the fresh-state path above.
+                    self.state = jax.device_put(
+                        restored, self._state_shardings(restored))
                     log.info("resumed at step %d", int(self.state.step))
 
         self.step_fn = make_train_step(self.model, cfg, self.env)
         self._metrics_path = os.path.join(workdir, "metrics.jsonl")
         self._preempted = threading.Event()
+        self.preempt_observed_step: Optional[int] = None
         self._eval_fn = None
         self.val_loader: Optional[Iterator] = None
 
-    def install_preemption_handler(self,
-                                   signals=(signal.SIGTERM,)) -> None:
-        """Catch preemption signals (SIGTERM is what TPU maintenance /
-        spot reclamation sends) and finish gracefully: the training loop
-        checkpoints the current state and returns instead of dying
-        mid-step.  Restart with ``transfer=True`` to resume.  (The
-        reference's only recovery story is rerunning with ``--transfer``
-        from the last 50-step save — ``train.py:238-251``.)"""
+    def install_preemption_handler(
+            self, signals=(signal.SIGTERM, signal.SIGINT)):
+        """Catch preemption signals and finish gracefully: the training
+        loop checkpoints the current state, waits on the checkpoint
+        durability barrier, and returns instead of dying mid-step.
+        SIGTERM is what TPU maintenance / spot reclamation sends;
+        SIGINT makes a Ctrl-C'd interactive run exit just as cleanly.
+        Restart with ``transfer=True`` to resume.  (The reference's only
+        recovery story is rerunning with ``--transfer`` from the last
+        50-step save — ``train.py:238-251``.)
+
+        Returns an ``uninstall()`` callable that restores the previous
+        handlers — installation is no longer forever, so tests and
+        embedding processes (e.g. a notebook driving several trainers)
+        can scope the handler to one training run.
+        """
 
         prev = {}
 
@@ -137,14 +164,27 @@ class Trainer:
             # Chain whatever handler was installed before us — on pods,
             # jax.distributed.initialize registers the preemption-sync
             # notifier on SIGTERM, and clobbering it would leave
-            # reached_preemption_sync_point permanently False.
+            # reached_preemption_sync_point permanently False.  The
+            # default SIGINT handler is deliberately NOT chained: it
+            # raises KeyboardInterrupt, which would turn this graceful
+            # stop into the emergency-checkpoint crash path.
             p = prev.get(signum)
-            if callable(p):
+            if callable(p) and p is not signal.default_int_handler:
                 p(signum, frame)
 
         for s in signals:
             prev[s] = signal.getsignal(s)
             signal.signal(s, handler)
+
+        def uninstall():
+            for s, p in prev.items():
+                # Only restore what we still own — if someone installed
+                # their own handler after us, clobbering it here would
+                # repeat the exact bug this handle exists to fix.
+                if signal.getsignal(s) is handler:
+                    signal.signal(s, p if p is not None else signal.SIG_DFL)
+
+        return uninstall
 
     def _stop_requested(self, step: int) -> bool:
         """Multi-host-safe preemption check.  A process-local flag alone
@@ -253,8 +293,13 @@ class Trainer:
                 batch = next(self.loader)
                 batch = {"imgs": batch["imgs"], "R": batch["R"],
                          "T": batch["T"], "K": batch["K"]}
-                self.state, metrics = self.step_fn(self.state, batch,
-                                                   self.rng)
+                # Transient backend faults at dispatch (UNAVAILABLE,
+                # reset connections) get the shared retry policy; real
+                # step failures are non-retryable and fall through to
+                # the emergency checkpoint below.
+                self.state, metrics = _STEP_RETRY.call(
+                    lambda: self.step_fn(self.state, batch, self.rng),
+                    describe=f"train step {step + 1}")
                 step += 1
 
                 if profiling and step >= profile_steps[1]:
@@ -332,6 +377,9 @@ class Trainer:
                     # wrote this step — force=True would delete and rewrite
                     # the finished checkpoint, reopening the loss window a
                     # mid-rewrite SIGKILL was supposed to be protected from.
+                    self.preempt_observed_step = step
+                    log.warning("preemption flag observed at step %d",
+                                step)
                     if not saved_this_step:
                         # The periodic branches carry the NaN guard; with
                         # log/ckpt cadences disabled nothing has checked
@@ -345,6 +393,10 @@ class Trainer:
                                 f"{gnorm} at preemption (step {step}); "
                                 "last finite checkpoint preserved")
                         self.ckpt.save(self.state, force=True)
+                    # Durability barrier: "saved then stopped" must mean
+                    # the bytes are committed before the process exits —
+                    # async saves make this wait load-bearing.
+                    self.ckpt.wait_until_finished()
                     log.warning("preempted at step %d; state saved", step)
                     break
         except FloatingPointError:
@@ -354,6 +406,7 @@ class Trainer:
             # restart with transfer=True loses at most ckpt_every steps.
             try:
                 self.ckpt.save(self.state, force=True)
+                self.ckpt.wait_until_finished()
             except Exception:  # pragma: no cover - best effort
                 log.exception("emergency checkpoint failed")
             raise

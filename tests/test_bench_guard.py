@@ -53,6 +53,52 @@ def test_acquire_backend_fails_fast_on_dial_hang(monkeypatch):
     assert calls["n"] == 1          # no retry, no 75s sleeps
 
 
+def test_acquire_backend_records_dial_telemetry(monkeypatch):
+    """Every acquisition resets and refills ``bench._LAST_DIAL`` with the
+    attempt count and per-retry backoff records — the telemetry ``main``
+    embeds in the structured failure JSON."""
+    calls = {"n": 0}
+    real_devices = jax.devices
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: TPU backend stalled")
+        return real_devices()
+
+    monkeypatch.setattr(jax, "devices", flaky)
+    bench._acquire_backend(attempts=4, wait_s=0.01)
+    assert bench._LAST_DIAL["attempts"] == 3
+    retries = bench._LAST_DIAL["retries"]
+    assert [r["attempt"] for r in retries] == [1, 2]
+    assert all("UNAVAILABLE" in r["error"] for r in retries)
+    assert all(abs(r["backoff_s"] - 0.01) < 1e-9 for r in retries)
+
+
+def test_main_failure_json_carries_dial_telemetry(monkeypatch, capsys):
+    """The failure record embeds the dial attempts/backoffs, so a voided
+    round shows exactly what the retry loop did before conceding."""
+    import functools
+    import json
+
+    def always_down():
+        raise RuntimeError("UNAVAILABLE: tunnel outage")
+
+    monkeypatch.setattr(jax, "devices", always_down)
+    # main() calls _acquire_backend() with no args; shrink its budget
+    # (the partial binds the original before setattr replaces the name)
+    monkeypatch.setattr(
+        bench, "_acquire_backend",
+        functools.partial(bench._acquire_backend, attempts=2,
+                          wait_s=0.01))
+    assert bench.main() == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] is None and "UNAVAILABLE" in rec["error"]
+    assert rec["dial"]["attempts"] == 2
+    assert len(rec["dial"]["retries"]) == 1
+    assert rec["dial"]["retries"][0]["attempt"] == 1
+
+
 def test_main_emits_backend_dial_timeout_record(monkeypatch, capsys):
     import json
 

@@ -1,0 +1,464 @@
+"""Chaos suite: injected faults against the real serving engine and the
+async checkpoint writer (ISSUE acceptance: zero hung futures, zero lost
+requests, the engine returns to ``ok`` once faults stop, and training
+resumes from an async checkpoint at the exact preempted step, bit-equal
+to the synchronous oracle).
+
+Faults come from :mod:`diff3d_tpu.testing.faults` — deterministic and
+seedable, so every schedule here replays exactly.  All device work uses
+the tiny shallow config; programs used by timing-sensitive tests are
+pre-warmed so a first-use XLA compile can't masquerade as a stuck step.
+"""
+
+import dataclasses
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from diff3d_tpu.config import ServingConfig
+from diff3d_tpu.config import test_config as make_tiny_config
+from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
+from diff3d_tpu.models import XUNet
+from diff3d_tpu.runtime.retry import RetryPolicy, is_transient_io_error
+from diff3d_tpu.sampling import Sampler
+from diff3d_tpu.serving import (EngineDraining, EngineStepError,
+                                EngineStopTimeout, EngineStopped,
+                                ProgramCache, ServingService, ViewRequest)
+from diff3d_tpu.testing.faults import (FaultInjected, FaultInjector,
+                                       wrap_sampler)
+from diff3d_tpu.train import CheckpointManager, Trainer, create_train_state
+from diff3d_tpu.train.trainer import init_params
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def chaos_env():
+    cfg = make_tiny_config(imgsize=8, ch=8, shallow=True)
+    model = XUNet(cfg.model)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    sampler = Sampler(model, params, cfg)
+    ds = SyntheticDataset(num_objects=4, num_views=6, imgsize=8)
+    # Pre-compile the programs the watchdog/stop tests launch under tight
+    # deadlines (compiles share the sampler's jit cache, so every service
+    # built on this sampler reuses them).
+    pc = ProgramCache(sampler)
+    gb = int(sampler.w.shape[0])
+    for bucket, lanes in (((8, 8, 4), 1), ((8, 8, 4), 2), ((8, 8, 8), 1)):
+        pc.warmup(bucket, lanes, gb)
+    return cfg, sampler, ds
+
+
+def _views_dict(ds, i):
+    v = ds.all_views(i)
+    return {"imgs": np.asarray(v["imgs"]), "R": np.asarray(v["R"]),
+            "T": np.asarray(v["T"]), "K": np.asarray(v["K"])}
+
+
+def _mk_request(ds, i, n_views=3, seed=0, timeout_s=None):
+    return ViewRequest(_views_dict(ds, i), seed=seed, n_views=n_views,
+                       timeout_s=timeout_s)
+
+
+def _direct(sampler, ds, i, n_views, seed):
+    return sampler.synthesize(ds.all_views(i), jax.random.PRNGKey(seed),
+                              max_views=n_views)
+
+
+def make_service(cfg, sampler, injector=None, **over):
+    serving = dict(port=0, max_batch=4, max_queue=8, max_wait_ms=20.0,
+                   max_views=6, default_timeout_s=60.0,
+                   step_retry_backoff_s=0.02, retry_after_s=1.0)
+    serving.update(over)
+    cfg2 = dataclasses.replace(cfg, serving=ServingConfig(**serving))
+    s = (wrap_sampler(sampler, injector) if injector is not None
+         else sampler)
+    return ServingService(s, cfg2)
+
+
+def _wait_for(pred, timeout=30.0, poll=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# Serving: step faults, watchdog, drain, stop
+# ---------------------------------------------------------------------------
+
+
+def test_transient_step_fault_retried_transparently(chaos_env):
+    """One injected dispatch fault: the engine's internal retry absorbs
+    it — the client sees a normal, bit-identical result and health never
+    leaves ``ok``."""
+    cfg, sampler, ds = chaos_env
+    inj = FaultInjector(seed=0)
+    inj.add("engine.step", at_calls=(1,))
+    svc = make_service(cfg, sampler, inj, step_retry_attempts=2,
+                       watchdog_timeout_s=0.0).start(serve_http=False)
+    try:
+        req = svc.engine.submit(_mk_request(ds, 0, n_views=3, seed=101))
+        out = req.result(timeout=120)
+        np.testing.assert_array_equal(out, _direct(sampler, ds, 0, 3, 101))
+        assert svc.engine.health == "ok"
+        snap = svc.metrics_snapshot()
+        assert snap["counters"]["serving_engine_step_faults_total"] == 0
+        assert snap["counters"]["serving_requests_completed_total"] == 1
+        assert inj.fired["engine.step"] == 1
+    finally:
+        svc.stop()
+
+
+def test_persistent_faults_degrade_then_recover(chaos_env):
+    """Faults outlasting the retry budget: affected requests resolve with
+    a typed retryable error (no hung futures), the engine degrades
+    (halved batch ceiling, queue soft limit), and once the fault source
+    stops it returns to ``ok`` after consecutive clean steps."""
+    cfg, sampler, ds = chaos_env
+    inj = FaultInjector(seed=0)
+    inj.add("engine.step", first_n=4)     # outlasts 2 attempts, twice
+    svc = make_service(cfg, sampler, inj, step_retry_attempts=2,
+                       watchdog_timeout_s=0.0,
+                       degraded_recovery_steps=2).start(serve_http=False)
+    try:
+        a = svc.engine.submit(_mk_request(ds, 0, n_views=3, seed=201))
+        with pytest.raises(EngineStepError) as ei:
+            a.result(timeout=30)
+        assert ei.value.retry_after_s == 1.0
+        assert svc.engine.health == "degraded"
+        assert svc.health()["status"] == "degraded"
+        assert svc.engine._effective_max_batch() == 2   # halved from 4
+
+        b = svc.engine.submit(_mk_request(ds, 1, n_views=3, seed=202))
+        with pytest.raises(EngineStepError):
+            b.result(timeout=30)
+
+        # fault budget exhausted: the next request runs clean and its two
+        # view steps satisfy degraded_recovery_steps=2
+        c = svc.engine.submit(_mk_request(ds, 2, n_views=3, seed=203))
+        out = c.result(timeout=120)
+        np.testing.assert_array_equal(out, _direct(sampler, ds, 2, 3, 203))
+        _wait_for(lambda: svc.engine.health == "ok",
+                  what="engine recovery")
+        snap = svc.metrics_snapshot()
+        assert snap["counters"]["serving_engine_step_faults_total"] == 2
+        assert all(r.done() for r in (a, b, c))         # nothing hung
+    finally:
+        svc.stop()
+
+
+def test_watchdog_rejects_stuck_step(chaos_env):
+    """A wedged dispatch (injected 1.5s stall vs a 0.3s watchdog): the
+    in-flight requests fail fast with a typed retryable error instead of
+    hanging, the trip is counted once, and queued work in other buckets
+    still completes after recovery."""
+    cfg, sampler, ds = chaos_env
+    inj = FaultInjector(seed=0)
+    inj.add("engine.step", at_calls=(1,), kind="slow", delay_s=1.5)
+    svc = make_service(cfg, sampler, inj, watchdog_timeout_s=0.3,
+                       step_retry_attempts=1, degraded_recovery_steps=1,
+                       max_wait_ms=300.0).start(serve_http=False)
+    try:
+        # a+b co-batch (same bucket, admitted together inside the 300ms
+        # flush window); c waits in a different bucket.
+        a = svc.engine.submit(_mk_request(ds, 0, n_views=3, seed=301))
+        b = svc.engine.submit(_mk_request(ds, 1, n_views=3, seed=302))
+        c = svc.engine.submit(_mk_request(ds, 2, n_views=5, seed=303))
+
+        t0 = time.monotonic()
+        with pytest.raises(EngineStepError) as ei:
+            a.result(timeout=10)
+        # rejected by the watchdog ~0.3s in, NOT after the 1.5s stall
+        assert time.monotonic() - t0 < 1.4
+        assert ei.value.retry_after_s is not None
+        with pytest.raises(EngineStepError):
+            b.result(timeout=10)
+
+        out = c.result(timeout=120)
+        np.testing.assert_array_equal(out, _direct(sampler, ds, 2, 5, 303))
+        _wait_for(lambda: svc.engine.health == "ok",
+                  what="post-watchdog recovery")
+        snap = svc.metrics_snapshot()
+        assert snap["counters"]["serving_engine_watchdog_trips_total"] == 1
+        assert all(r.done() for r in (a, b, c))
+    finally:
+        svc.stop()
+
+
+def test_drain_mode_blocks_admission_and_finishes_inflight(chaos_env):
+    """drain(): health moves to ``draining``, new submissions get a typed
+    EngineDraining with Retry-After, and in-flight work runs to
+    completion — the clean-rollout contract."""
+    cfg, sampler, ds = chaos_env
+    svc = make_service(cfg, sampler,
+                       watchdog_timeout_s=0.0).start(serve_http=False)
+    try:
+        a = svc.engine.submit(_mk_request(ds, 3, n_views=6, seed=401))
+        _wait_for(lambda: svc.engine._inflight_count() > 0,
+                  what="request admission")
+        done = {}
+        t = threading.Thread(
+            target=lambda: done.update(ok=svc.drain(timeout=60)))
+        t.start()
+        _wait_for(lambda: svc.engine.health == "draining",
+                  what="draining state")
+        with pytest.raises(EngineDraining) as ei:
+            svc.engine.submit(_mk_request(ds, 0, n_views=3, seed=402))
+        assert ei.value.retry_after_s == 1.0
+        t.join(120)
+        assert done.get("ok") is True
+        out = a.result(timeout=0)         # already resolved by the drain
+        np.testing.assert_array_equal(out, _direct(sampler, ds, 3, 6, 401))
+    finally:
+        svc.stop()
+
+
+def test_stop_timeout_reports_leaked_worker(chaos_env):
+    """stop(timeout) on a wedged worker: raises EngineStopTimeout, bumps
+    the leak counter, and resolves in-flight futures with EngineStopped —
+    never a silent return with a live thread and hung clients."""
+    cfg, sampler, ds = chaos_env
+    inj = FaultInjector(seed=0)
+    inj.add("engine.step", at_calls=(1,), kind="slow", delay_s=2.5)
+    svc = make_service(cfg, sampler, inj, watchdog_timeout_s=0.0,
+                       step_retry_attempts=1).start(serve_http=False)
+    a = svc.engine.submit(_mk_request(ds, 0, n_views=3, seed=501))
+    _wait_for(lambda: inj.calls["engine.step"] >= 1,
+              what="dispatch to enter the stall")
+    worker = svc.engine._thread
+    with pytest.raises(EngineStopTimeout):
+        svc.engine.stop(timeout=0.2)
+    assert svc.metrics_snapshot()["counters"][
+        "serving_engine_stop_timeout_total"] == 1
+    with pytest.raises(EngineStopped):
+        a.result(timeout=1)
+    # the leaked thread does exit once the stall ends (stop flag is set)
+    worker.join(60)
+    assert not worker.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# Async checkpointing under IO faults
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_state(chaos_env):
+    cfg, sampler, _ = chaos_env
+    return cfg, create_train_state(sampler.params, cfg.train)
+
+
+def _abstract(state):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+
+
+def _fast_io_retry(attempts=4):
+    return RetryPolicy(max_attempts=attempts, base_delay_s=0.01,
+                       max_delay_s=0.02, jitter=0.0,
+                       classify=is_transient_io_error,
+                       sleep=lambda s: None)
+
+
+def test_async_checkpoint_bitwise_matches_sync_oracle(tmp_path, tiny_state):
+    """The ISSUE pin: the async writer's directory is byte-identical to
+    the synchronous path's, and restores bit-equal."""
+    cfg, state = tiny_state
+    sync = CheckpointManager(str(tmp_path / "sync"), mode="full_sliced")
+    asyn = CheckpointManager(str(tmp_path / "async"), mode="full_sliced",
+                             async_writes=True)
+    assert sync.save(state, force=True)
+    assert asyn.save(state, force=True)
+    asyn.wait_until_finished()
+
+    sdir, adir = tmp_path / "sync" / "0", tmp_path / "async" / "0"
+    assert sorted(os.listdir(sdir)) == sorted(os.listdir(adir))
+    for name in sorted(os.listdir(sdir)):
+        assert (sdir / name).read_bytes() == (adir / name).read_bytes(), \
+            f"{name} differs between sync and async saves"
+
+    ra = asyn.restore(_abstract(state))
+    rs = sync.restore(_abstract(state))
+    for a, b, orig in zip(jax.tree.leaves(ra), jax.tree.leaves(rs),
+                          jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(orig))
+    asyn.close()
+
+
+def test_async_checkpoint_survives_transient_io_faults(tmp_path,
+                                                       tiny_state):
+    """Injected write + commit faults inside the retry budget: the save
+    still lands, durable and bit-equal — the barrier raises nothing."""
+    cfg, state = tiny_state
+    inj = FaultInjector(seed=0)
+    inj.add("write", at_calls=(1,))       # first leaf write fails once
+    inj.add("commit", at_calls=(1,))      # first commit attempt fails too
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), mode="full_sliced",
+                            async_writes=True,
+                            write_retry=_fast_io_retry(),
+                            fault_hook=inj.fire)
+    assert mgr.save(state, force=True)
+    mgr.wait_until_finished()             # transient faults: no raise
+    assert mgr.latest_step() == 0
+    assert inj.fired["write"] == 1 and inj.fired["commit"] == 1
+    restored = mgr.restore(_abstract(state))
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_async_write_failure_surfaces_at_next_save(tmp_path, tiny_state):
+    cfg, state = tiny_state
+    inj = FaultInjector(seed=0)
+    inj.add("commit", first_n=10 ** 6)    # permanent
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), mode="full_sliced",
+                            async_writes=True,
+                            write_retry=_fast_io_retry(attempts=2),
+                            fault_hook=inj.fire)
+    assert mgr.save(state, force=True)
+    _wait_for(lambda: mgr._async_error is not None,
+              what="writer to exhaust its retries")
+    with pytest.raises(FaultInjected):
+        mgr.save(state, force=True)       # deferred error, not silence
+    mgr.close()
+
+
+def test_async_barrier_surfaces_failure_then_recovers(tmp_path,
+                                                      tiny_state):
+    """The durability barrier raises a permanent write failure; once the
+    fault source clears, re-saving the same step lands normally."""
+    cfg, state = tiny_state
+    inj = FaultInjector(seed=0)
+    inj.add("commit", first_n=10 ** 6)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), mode="full_sliced",
+                            async_writes=True,
+                            write_retry=_fast_io_retry(attempts=2),
+                            fault_hook=inj.fire)
+    assert mgr.save(state, force=True)
+    with pytest.raises(FaultInjected):
+        mgr.wait_until_finished()
+    assert mgr.latest_step() is None      # nothing half-published
+    inj.clear()
+    assert mgr.save(state, force=True)
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 0
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Trainer: real SIGTERM -> async checkpoint -> exact resume
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_sigterm_async_checkpoint_exact_resume(tmp_path):
+    """End-to-end preemption chaos: a real SIGTERM (injected mid-loop)
+    drives the installed handler; the trainer checkpoints the exact
+    observed step through the ASYNC writer, waits on the durability
+    barrier, and the saved state is bit-equal to a synchronous-oracle
+    run preempted at the same step.  Resuming finishes the run."""
+    cfg = make_tiny_config(imgsize=8, ch=8, shallow=True)
+    cfg = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, max_steps=6, ckpt_every=100, log_every=0,
+        ckpt_mode="full_sliced", ckpt_async=True))
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=8)
+    B = cfg.train.global_batch
+
+    inj = FaultInjector(seed=0)
+    inj.add("loader", at_calls=(4,), kind="sigterm")
+
+    class SigtermLoader:
+        def __init__(self):
+            self._it = InfiniteLoader(ds, B, seed=0, num_workers=0)
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            inj.fire("loader")            # call 4 delivers a real SIGTERM
+            return next(self._it)
+
+    tr = Trainer(cfg, SigtermLoader(), workdir=str(tmp_path / "chaos"))
+    uninstall = tr.install_preemption_handler()
+    try:
+        state = tr.train()
+    finally:
+        uninstall()
+    assert tr.preempt_observed_step == 4
+    assert int(state.step) == 4
+    assert tr.ckpt.latest_step() == 4     # durable before train() returned
+
+    # Synchronous oracle: same run, sync writer, flag raised (not
+    # signalled) at the same batch.
+    cfg_sync = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, ckpt_async=False))
+    box = [None]
+
+    class FlagLoader:
+        def __init__(self):
+            self.n = 0
+            self._it = InfiniteLoader(ds, B, seed=0, num_workers=0)
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.n += 1
+            if self.n == 4:
+                box[0]._preempted.set()
+            return next(self._it)
+
+    tr2 = Trainer(cfg_sync, FlagLoader(), workdir=str(tmp_path / "oracle"))
+    box[0] = tr2
+    s2 = tr2.train()
+    assert int(s2.step) == 4
+
+    ra = tr.ckpt.restore(tr._abstract_state())
+    rs = tr2.ckpt.restore(tr2._abstract_state())
+    for a, b in zip(jax.tree.leaves(ra), jax.tree.leaves(rs)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Resume from the async checkpoint at the exact preempted step and
+    # finish the run.
+    loader3 = InfiniteLoader(ds, B, seed=0, num_workers=0, start_step=4)
+    tr3 = Trainer(cfg, loader3, workdir=str(tmp_path / "chaos"),
+                  transfer=True)
+    assert int(tr3.state.step) == 4
+    s3 = tr3.train()
+    assert int(s3.step) == 6
+
+
+# ---------------------------------------------------------------------------
+# Soak (opt-in): the chaos_serving tool against a live engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_serving_soak_tool(tmp_path):
+    """tools/chaos_serving.py survival run: mixed error/slow faults, then
+    a clean recovery window — exits 0 only with zero hung/lost requests
+    and final health ``ok``."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos_serving.py"),
+         "--requests", "12", "--fault-rate", "0.3", "--slow-rate", "0.1",
+         "--slow-s", "0.4", "--watchdog-s", "2.0", "--seed", "0",
+         "--json"],
+        capture_output=True, text=True, timeout=1500,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["hung"] == 0 and rec["lost"] == 0
+    assert rec["final_health"] == "ok"
+    assert rec["completed"] + rec["failed_retryable"] == rec["submitted"]

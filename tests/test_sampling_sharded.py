@@ -155,7 +155,12 @@ def _device_record(sampler, views, cfg, n_views):
     rec_i, rec_R, rec_T = sampler._record_init(
         imgs[0], np.asarray(views["R"], np.float32),
         np.asarray(views["T"], np.float32), n_views)
-    return (jnp.asarray(rec_i), jnp.asarray(rec_R), jnp.asarray(rec_T),
+    # jnp.copy, not bare jnp.asarray: the record carry is DONATED, and
+    # asarray may zero-copy alias the numpy buffer — donating an aliased
+    # buffer leaves the carry pointing at freed host memory (the same
+    # contract Sampler._owned enforces for the public step API).
+    return (jnp.copy(jnp.asarray(rec_i)), jnp.asarray(rec_R),
+            jnp.asarray(rec_T),
             jnp.asarray(np.asarray(views["K"], np.float32)))
 
 

@@ -594,12 +594,89 @@ def test_preemption_checkpoints_and_stops(tmp_path):
     box[0] = tr
     state = tr.train()
     assert int(state.step) == 3          # stopped right after the flag
+    assert tr.preempt_observed_step == 3  # observed step is recorded
     tr.ckpt.wait()
     assert tr.ckpt.latest_step() == 3    # exact-step checkpoint exists
 
     # resume picks up at the preempted step
     tr2 = Trainer(cfg, None, env, workdir=str(tmp_path), transfer=True)
     assert int(tr2.state.step) == 3
+
+
+def test_preemption_handler_sigint_and_uninstall(tmp_path):
+    """install_preemption_handler also covers SIGINT (a ^C must behave
+    like a preemption: checkpoint + clean stop, not a stack trace), and
+    the returned uninstall handle restores the previous handlers without
+    clobbering one somebody else installed in the meantime."""
+    import signal
+    import time
+
+    cfg = tiny_cfg(max_steps=2, ckpt_every=10, log_every=0)
+    tr = Trainer(cfg, None, workdir=str(tmp_path))
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_term = signal.getsignal(signal.SIGTERM)
+    uninstall = tr.install_preemption_handler()
+    try:
+        # a real SIGINT sets the flag instead of raising KeyboardInterrupt
+        os.kill(os.getpid(), signal.SIGINT)
+        deadline = time.monotonic() + 5
+        while not tr._preempted.is_set() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert tr._preempted.is_set()
+    finally:
+        uninstall()
+    assert signal.getsignal(signal.SIGINT) is prev_int
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+    uninstall()                           # idempotent
+
+    # uninstall must not stomp a handler installed after ours
+    tr2 = Trainer(cfg, None, workdir=str(tmp_path), transfer=False)
+    uninstall2 = tr2.install_preemption_handler()
+
+    def foreign(signum, frame):           # pragma: no cover - never fired
+        pass
+
+    try:
+        signal.signal(signal.SIGTERM, foreign)
+        uninstall2()
+        assert signal.getsignal(signal.SIGTERM) is foreign
+        assert signal.getsignal(signal.SIGINT) is prev_int
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+
+
+def test_full_sliced_deterministic_resume(tmp_path):
+    """The ISSUE-6 satellite pin: checkpoint at step N (through the
+    default ASYNC writer), restore into a fresh trainer with the loader
+    sought to N, and the next K steps are bit-identical to a run that was
+    never interrupted — params, EMA, Adam moments, step counter, and the
+    data-loader position all line up exactly."""
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=8)
+
+    def loader(start=0):
+        return InfiniteLoader(ds, 8, seed=0, num_workers=0,
+                              start_step=start)
+
+    cfg_a = tiny_cfg(max_steps=3, ckpt_every=3, log_every=0,
+                     ckpt_mode="full_sliced")
+    tr = Trainer(cfg_a, loader(), workdir=str(tmp_path / "resumed"))
+    tr.train()
+    tr.ckpt.wait()
+    assert tr.ckpt.latest_step() == 3
+
+    cfg_b = tiny_cfg(max_steps=6, ckpt_every=100, log_every=0,
+                     ckpt_mode="full_sliced")
+    tr2 = Trainer(cfg_b, loader(start=3), workdir=str(tmp_path / "resumed"),
+                  transfer=True)
+    assert int(tr2.state.step) == 3
+    resumed = jax.device_get(tr2.train())
+
+    tr3 = Trainer(cfg_b, loader(), workdir=str(tmp_path / "oracle"))
+    oracle = jax.device_get(tr3.train())
+
+    assert int(resumed.step) == 6 and int(oracle.step) == 6
+    for a, b in zip(jax.tree.leaves(oracle), jax.tree.leaves(resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_context_parallel_requires_model_axis():
