@@ -186,7 +186,9 @@ def _train_bench(configs, n_steps: int, config: str):
 
 
 def _sampler_bench(config: str = "srn64", n_views: int = 4,
-                   object_batch: int = 1, use_mesh: bool = False):
+                   object_batch: int = 1, use_mesh: bool = False,
+                   sampler_kind: str = "ancestral",
+                   steps: int | None = None):
     """Seconds per synthesised view, reference sampler config (256 steps,
     8-weight guidance sweep, ``/root/reference/sampling.py:130-158``) —
     one compiled lax.scan per view.  ``srn128`` runs the full-resolution
@@ -202,6 +204,11 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     (object axis sharded over the data axis — the sharded serving/eval
     runtime); ``object_batch`` should then be a multiple of the data-axis
     size or padding lanes dilute the per-view number.
+
+    ``sampler_kind`` / ``steps`` select the reverse-process update and
+    schedule subset (``diffusion/core.py``): the default is the
+    reference protocol above; ``("ddim", 16)`` times the few-step
+    deterministic path the serving layer exposes.
     """
     import jax
     import numpy as np
@@ -219,9 +226,12 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     # past the dev tunnel's RPC deadline — chunk it into 4 executions
     # (bit-identical result, test_sampling pins it; chunks=1 elsewhere).
     chunks = 4 if config == "srn128" else 1
+    if steps is not None:
+        chunks = min(chunks, steps)    # chunks must divide the schedule
     mesh_env = make_mesh(cfg.mesh) if use_mesh else None
     sampler = Sampler(model, init_params(model, cfg, rng), cfg,
-                      scan_chunks=chunks, mesh=mesh_env)
+                      scan_chunks=chunks, mesh=mesh_env,
+                      sampler_kind=sampler_kind, steps=steps)
 
     s = cfg.model.H
 
@@ -254,6 +264,51 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     raw = time.perf_counter() - t0
     return raw / (object_batch * (n_views - 1)), raw, (object_batch
                                                        * (n_views - 1))
+
+
+def _sampler_steps_sweep(config: str = "srn64",
+                         steps_list=(256, 64, 16, 8), n_views: int = 4,
+                         object_batch: int = 1, use_mesh: bool = False,
+                         bench_fn=None) -> dict:
+    """Few-step sampling sweep: s/view of the deterministic DDIM sampler
+    at each schedule subset, plus speedup relative to the first (full
+    256-step) point.  Model calls scale linearly with the schedule
+    (``Sampler.model_calls_per_view == steps``, pinned by test_ddim), so
+    the sweep quantifies how much of the 32x fewer-calls headroom the
+    runtime actually converts into wall-clock speedup (per-step overhead,
+    warmup amortisation, and host sync eat the rest).
+
+    ``bench_fn`` (default :func:`_sampler_bench`) is injectable so the
+    guard test can validate the sweep's structure without compiling four
+    full-width samplers.
+    """
+    bench_fn = bench_fn or _sampler_bench
+    points = []
+    for steps in steps_list:
+        spv, raw, n_eff = bench_fn(config, n_views=n_views,
+                                   object_batch=object_batch,
+                                   use_mesh=use_mesh,
+                                   sampler_kind="ddim", steps=steps)
+        points.append({
+            "steps": steps,
+            "sampler": "ddim",
+            "sec_per_view": round(spv, 3),
+            "raw_seconds": round(raw, 3),
+            "effective_views": n_eff,
+            "model_calls_per_view": steps,
+        })
+    base = points[0]["sec_per_view"]
+    for pt in points:
+        pt["speedup_vs_256"] = (round(base / pt["sec_per_view"], 2)
+                                if pt["sec_per_view"] else None)
+    return {
+        "metric": f"sampler_steps_sweep_{config}",
+        "unit": "s/view",
+        "vs_baseline": None,   # reference has no few-step sampler at all
+        "n_views": n_views,
+        "object_batch": object_batch,
+        "points": points,
+    }
 
 
 def _acquire_backend(attempts: int = 6, wait_s: float = 75.0):
@@ -427,6 +482,12 @@ def main() -> int:
                 payload["sampler"]["sharded"] = {
                     "error": str(e).splitlines()[0][:200]}
         try:
+            # Few-step DDIM sweep at srn64: how wall-clock tracks the
+            # 256 -> 8 model-call reduction on real hardware.
+            payload["sampler_steps"] = _sampler_steps_sweep()
+        except Exception as e:
+            payload["sampler_steps"] = {"error": str(e).splitlines()[0][:200]}
+        try:
             # Object-batch 2, 2 views each = 2 effective synthesised views
             # per batched 256-step scan at 16384 tokens/frame, full-width
             # srn128 — the configuration eval_cli ships with (the unbatched
@@ -467,6 +528,14 @@ def main() -> int:
             except Exception as e:
                 payload["sampler128"]["sharded"] = {
                     "error": str(e).splitlines()[0][:200]}
+        try:
+            # Same sweep at the full-width 128^2 config (object-batched
+            # like the sampler128 block so the scan stays amortised).
+            payload["sampler128_steps"] = _sampler_steps_sweep(
+                "srn128", n_views=2, object_batch=2)
+        except Exception as e:
+            payload["sampler128_steps"] = {
+                "error": str(e).splitlines()[0][:200]}
 
     print(json.dumps(payload))
     return 0

@@ -95,7 +95,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_views", type=int, default=None,
                    help="cap views per object (full object if omitted)")
     p.add_argument("--steps", type=int, default=None,
-                   help="diffusion steps (reference: 256)")
+                   help="diffusion steps (reference: 256) — the DENSE "
+                        "training grid; see --sampler_steps for the "
+                        "few-step sampling subset")
+    p.add_argument("--sampler", choices=["ancestral", "ddim"],
+                   default="ancestral",
+                   help="reverse-process update: 'ancestral' (paper's "
+                        "stochastic sampler) or 'ddim' (deterministic "
+                        "eta=0, enables few-step sampling)")
+    p.add_argument("--sampler_steps", type=int, default=None,
+                   help="few-step schedule: reverse steps per view, a "
+                        "divisor of the dense grid (e.g. 16 with 256 "
+                        "timesteps); default = full grid")
+    p.add_argument("--parity_objects", type=int, default=0,
+                   help="ALSO synthesise this many eval objects with the "
+                        "full-grid ancestral oracle at matched seeds and "
+                        "report PSNR/SSIM of the evaluated sampler "
+                        "against it (sampler_parity in the output JSON) — "
+                        "quantifies few-step quality degradation")
     p.add_argument("--scan_chunks", type=int, default=1,
                    help="split each view's diffusion scan into this many "
                         "device executions (must divide --steps; "
@@ -259,7 +276,8 @@ def main(argv=None) -> None:
                      "params %s)", dict(mesh_env.mesh.shape),
                      cfg.mesh.data_axis, cfg.mesh.param_sharding)
     sampler = Sampler(model, params, cfg,
-                      scan_chunks=args.scan_chunks, mesh=mesh_env)
+                      scan_chunks=args.scan_chunks, mesh=mesh_env,
+                      sampler_kind=args.sampler, steps=args.sampler_steps)
 
     if args.object_batch is None:
         # The batched model call (N*2B examples) and the [N, capacity, B,
@@ -332,6 +350,10 @@ def main(argv=None) -> None:
         "dataset": dataset_id,
         "checkpoint_step": int(step),
         "timesteps": int(cfg.diffusion.timesteps),
+        # The schedule changes every generated pixel: stale records from a
+        # different sampler/step count must hard-error, not silently mix.
+        "sampler": sampler.sampler_kind,
+        "sampler_steps": int(sampler.steps),
         "seed": int(args.seed),
         "max_views": args.max_views,
         "H": int(cfg.model.H),
@@ -497,7 +519,29 @@ def main(argv=None) -> None:
 
     record = {"checkpoint_step": step, **aggregate(args.w_index),
               "psnr_per_w": per_w_psnrs, "w_index": args.w_index,
-              "timesteps": cfg.diffusion.timesteps}
+              "timesteps": cfg.diffusion.timesteps,
+              "sampler": sampler.sampler_kind,
+              "sampler_steps": int(sampler.steps)}
+
+    # Matched-seed parity vs the full-grid ancestral oracle: same
+    # per-object keys, so the generations differ ONLY by the reverse
+    # schedule — the quality cost of few-step sampling, isolated.
+    if args.parity_objects:
+        from diff3d_tpu.evaluation import matched_seed_parity
+
+        par_objs = eval_objs[: args.parity_objects]
+        oracle = Sampler(model, params, cfg,
+                         scan_chunks=args.scan_chunks, mesh=mesh_env)
+        oracle_outs = [oracle.synthesize(obj_views[o], obj_keys[o],
+                                         max_views=args.max_views)
+                       for o in par_objs]
+        record["sampler_parity"] = {
+            "oracle": f"ancestral:{cfg.diffusion.timesteps}",
+            "sampler": f"{sampler.sampler_kind}:{sampler.steps}",
+            "objects": len(par_objs),
+            **matched_seed_parity([gens[o] for o in par_objs],
+                                  oracle_outs, w_index=args.w_index),
+        }
     if w_selected is not None:
         sel_agg = aggregate(w_selected)
         record["w_selected"] = w_selected

@@ -65,10 +65,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "up to this long for in-flight requests to finish "
                         "before stopping (0 = immediate stop)")
     p.add_argument("--steps", type=int, default=None,
-                   help="diffusion steps per view (reference: 256)")
+                   help="diffusion steps per view (reference: 256) — the "
+                        "DENSE training grid; see --sampler_steps for the "
+                        "few-step sampling subset")
+    p.add_argument("--sampler", choices=["ancestral", "ddim"],
+                   default="ancestral",
+                   help="default reverse-process update: 'ancestral' "
+                        "(paper's stochastic sampler) or 'ddim' "
+                        "(deterministic eta=0)")
+    p.add_argument("--sampler_steps", type=int, default=None,
+                   help="few-step schedule for the default sampler: "
+                        "reverse steps per view, a divisor of the dense "
+                        "grid (e.g. 16 with 256 timesteps); default = "
+                        "full grid")
+    p.add_argument("--schedules", default=None,
+                   help="extra compiled schedules to serve beyond the "
+                        "default, as 'kind:steps,...' (e.g. "
+                        "'ddim:16,ancestral:256'); requests naming any "
+                        "other schedule get a typed 503 with this list")
     p.add_argument("--scan_chunks", type=int, default=1,
                    help="split each view's diffusion scan into this many "
-                        "device executions (must divide --steps)")
+                        "device executions (must divide the per-view "
+                        "step count)")
     p.add_argument("--mesh", action="store_true",
                    help="shard serving over a device mesh (cfg.mesh): "
                         "the request batch's object axis rides the data "
@@ -143,15 +161,35 @@ def build_service(args):
         logging.info("serving on mesh %s (lane multiple %d)",
                      dict(mesh_env.mesh.shape), mesh_env.data_size)
     sampler = Sampler(model, params, cfg, scan_chunks=args.scan_chunks,
-                      mesh=mesh_env)
-    service = ServingService(sampler, cfg, params_version=version)
+                      mesh=mesh_env, sampler_kind=args.sampler,
+                      steps=args.sampler_steps)
+    extra_samplers = {}
+    if args.schedules:
+        for spec in args.schedules.split(","):
+            kind, _, steps_s = spec.strip().partition(":")
+            try:
+                sched = (kind, int(steps_s))
+            except ValueError:
+                raise SystemExit(
+                    f"--schedules entry {spec!r}: expected 'kind:steps'")
+            if sched == (sampler.sampler_kind, sampler.steps):
+                continue                    # already the default sampler
+            extra_samplers[sched] = Sampler(
+                model, params, cfg, scan_chunks=args.scan_chunks,
+                mesh=mesh_env, sampler_kind=sched[0], steps=sched[1])
+    service = ServingService(sampler, cfg, params_version=version,
+                             extra_samplers=extra_samplers or None)
     if args.warmup:
-        bucket = (cfg.model.H, cfg.model.W,
-                  record_capacity(cfg.serving.max_views))
-        secs = service.engine.programs.warmup(bucket,
-                                              sampler.lane_multiple,
-                                              sampler.w.shape[0])
-        logging.info("warmed bucket %s in %.1fs", bucket, secs)
+        from diff3d_tpu.serving import Bucket
+
+        cap = record_capacity(cfg.serving.max_views)
+        for s in [sampler, *extra_samplers.values()]:
+            bucket = Bucket(cfg.model.H, cfg.model.W, cap,
+                            s.steps, s.sampler_kind)
+            secs = service.engine.programs.warmup(bucket,
+                                                  s.lane_multiple,
+                                                  s.w.shape[0])
+            logging.info("warmed bucket %s in %.1fs", tuple(bucket), secs)
     return service
 
 

@@ -2,6 +2,11 @@ from diff3d_tpu.convert.torch_ckpt import (convert_state_dict,
                                            expected_torch_state,
                                            load_torch_checkpoint,
                                            verify_state_dict)
+from diff3d_tpu.convert.progressive import (adapt_params_resolution,
+                                            check_resolution_compatible,
+                                            init_student_from_teacher)
 
 __all__ = ["convert_state_dict", "expected_torch_state",
-           "load_torch_checkpoint", "verify_state_dict"]
+           "load_torch_checkpoint", "verify_state_dict",
+           "adapt_params_resolution", "check_resolution_compatible",
+           "init_student_from_teacher"]

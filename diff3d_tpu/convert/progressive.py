@@ -51,6 +51,18 @@ def adapt_params_resolution(params, dst_hw: Tuple[int, int]):
     return out
 
 
+def init_student_from_teacher(params, dst_hw: Tuple[int, int] | None = None):
+    """Fresh student params for one progressive-distillation round
+    (``diff3d_tpu.train.distill``): the teacher's weights, deep-copied so
+    the student's donated train step can never alias the teacher buffers
+    it must keep reading, optionally resolution-adapted first (a 64^2
+    teacher can seed a 128^2 student the same way full training transfers
+    across resolutions)."""
+    if dst_hw is not None:
+        params = adapt_params_resolution(params, dst_hw)
+    return jax.tree.map(jnp.copy, params)
+
+
 def check_resolution_compatible(src_params, dst_params) -> None:
     """Assert ``src_params`` (adapted) matches ``dst_params``'s tree —
     same widths everywhere; only pos_emb may have differed.  Raises

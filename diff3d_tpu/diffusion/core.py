@@ -22,6 +22,11 @@ import numpy as np
 # model.apply with its `rngs=`).
 DenoiseFn = Callable[[dict, jnp.ndarray], jnp.ndarray]
 
+# Reverse-process update rules understood by `sample_loop` / `Sampler`:
+# "ancestral" is the paper's stochastic DDPM step, "ddim" the deterministic
+# eta=0 update (Song et al., DDIM) over the same x0-prediction.
+SAMPLER_KINDS = ("ancestral", "ddim")
+
 
 def logsnr_schedule_cosine(t: jnp.ndarray, *, logsnr_min: float = -20.0,
                            logsnr_max: float = 20.0) -> jnp.ndarray:
@@ -138,6 +143,52 @@ def p_mean_variance(eps_cond: jnp.ndarray, eps_uncond: jnp.ndarray,
     return mean, sq_sigma_next * c
 
 
+def ddim_step(eps_cond: jnp.ndarray, eps_uncond: jnp.ndarray,
+              z: jnp.ndarray, logsnr: jnp.ndarray,
+              logsnr_next: jnp.ndarray, w: jnp.ndarray, *,
+              clip_x0: bool = True) -> jnp.ndarray:
+    """One deterministic DDIM step (eta = 0) in logSNR form.
+
+    Shares the CFG combine and clipped x0-prediction with
+    :func:`p_mean_variance`; after clipping, eps is RE-derived from the
+    clipped x0 (``eps = (z - alpha x0)/sigma``) so the update stays on the
+    manifold implied by the clamp, then
+    ``z_next = alpha_next x0 + sigma_next eps``.  At logsnr_next ==
+    logsnr_max (t = 0) sigma_next ~ 0 and this returns x0 — no special
+    final-step guard is needed.
+    """
+    alpha, sigma = alpha_sigma(logsnr)
+    alpha_next, sigma_next = alpha_sigma(logsnr_next)
+
+    w = w[:, None, None, None]
+    eps = (1.0 + w) * eps_cond - w * eps_uncond
+    z_start = (z - sigma * eps) / alpha
+    if clip_x0:
+        z_start = jnp.clip(z_start, -1.0, 1.0)
+        eps = (z - alpha * z_start) / sigma
+    return alpha_next * z_start + sigma_next * eps
+
+
+def sample_schedule_ts(steps: int | None, *, timesteps: int) -> jnp.ndarray:
+    """The ``[k + 1]`` time grid for a ``k``-step sampling run.
+
+    ``steps`` must divide ``timesteps`` (the dense grid size, 256 in the
+    paper configs): the result is the stride-``timesteps // steps`` subset
+    of ``linspace(1, 0, timesteps + 1)``, so every k-step logsnr grid is an
+    EXACT index subset of the dense grid and ``steps == timesteps`` (stride
+    1) reproduces the dense grid bit-for-bit — the ancestral parity oracle
+    relies on that.  ``steps=None`` means the full grid.
+    """
+    if steps is None:
+        steps = timesteps
+    steps = int(steps)
+    if steps < 1 or timesteps % steps:
+        raise ValueError(
+            f"steps={steps} must be a positive divisor of the dense "
+            f"schedule (timesteps={timesteps})")
+    return jnp.linspace(1.0, 0.0, timesteps + 1)[::timesteps // steps]
+
+
 class SampleState(NamedTuple):
     img: jnp.ndarray   # current z_t, [B, H, W, 3]
     rng: jax.Array
@@ -149,7 +200,8 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
                 target_T: jnp.ndarray, K: jnp.ndarray, w: jnp.ndarray,
                 rng: jax.Array, timesteps: int = 256,
                 logsnr_min: float = -20.0, logsnr_max: float = 20.0,
-                clip_x0: bool = True) -> jnp.ndarray:
+                clip_x0: bool = True, steps: int | None = None,
+                sampler_kind: str = "ancestral") -> jnp.ndarray:
     """Full reverse-diffusion for one novel view, as a single ``lax.scan``.
 
     Stochastic conditioning (reference ``sampling.py:129-155``): at every
@@ -166,17 +218,24 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
       target_R / target_T: pose of the view being synthesised.
       K: ``[3, 3]`` shared intrinsics.
       w: ``[B]`` guidance weights (one image per weight).
+      steps: schedule subset size (see :func:`sample_schedule_ts`);
+        ``None`` runs the full ``timesteps`` grid.
+      sampler_kind: one of :data:`SAMPLER_KINDS`.
     Returns:
       ``[B, H, W, 3]`` generated view.
     """
+    if sampler_kind not in SAMPLER_KINDS:
+        raise ValueError(
+            f"sampler_kind={sampler_kind!r} not in {SAMPLER_KINDS}")
     state, xs = sample_loop_prepare(
         record_len=record_len, rng=rng, timesteps=timesteps,
         shape=(w.shape[0],) + record_imgs.shape[-3:],
-        logsnr_min=logsnr_min, logsnr_max=logsnr_max)
+        logsnr_min=logsnr_min, logsnr_max=logsnr_max, steps=steps)
     state = sample_loop_scan(
         denoise_fn, state, xs, record_imgs=record_imgs, record_R=record_R,
         record_T=record_T, target_R=target_R, target_T=target_T, K=K,
-        w=w, logsnr_max=logsnr_max, clip_x0=clip_x0)
+        w=w, logsnr_max=logsnr_max, clip_x0=clip_x0,
+        deterministic=(sampler_kind == "ddim"))
     return state.img
 
 
@@ -185,7 +244,8 @@ def sample_view(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
                 record_len: jnp.ndarray, K: jnp.ndarray, w: jnp.ndarray,
                 rng: jax.Array, timesteps: int = 256,
                 logsnr_min: float = -20.0, logsnr_max: float = 20.0,
-                clip_x0: bool = True):
+                clip_x0: bool = True, steps: int | None = None,
+                sampler_kind: str = "ancestral"):
     """One autoregressive view step over a DEVICE-RESIDENT record.
 
     The record-carry contract (the sampler's host loop never touches the
@@ -214,7 +274,8 @@ def sample_view(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
         record_T=record_T, record_len=record_len,
         target_R=record_R[record_len], target_T=record_T[record_len],
         K=K, w=w, rng=k, timesteps=timesteps, logsnr_min=logsnr_min,
-        logsnr_max=logsnr_max, clip_x0=clip_x0)
+        logsnr_max=logsnr_max, clip_x0=clip_x0, steps=steps,
+        sampler_kind=sampler_kind)
     out2, record_imgs, record_len = sample_view_commit(
         record_imgs, record_len, out)
     return out2, record_imgs, record_len, rng
@@ -234,7 +295,7 @@ def sample_view_commit(record_imgs: jnp.ndarray, record_len: jnp.ndarray,
 
 def sample_loop_prepare(*, record_len: jnp.ndarray, rng: jax.Array,
                         timesteps: int, shape, logsnr_min: float,
-                        logsnr_max: float):
+                        logsnr_max: float, steps: int | None = None):
     """Initial carry + per-step scan inputs for :func:`sample_loop_scan`.
 
     Splitting preparation from the scan lets a caller CHUNK the reverse
@@ -245,8 +306,16 @@ def sample_loop_prepare(*, record_len: jnp.ndarray, rng: jax.Array,
     ~2-minute device execution trips an RPC deadline — e.g. the full-width
     128^2 sampler over this dev tunnel; direct-attached chips keep
     chunks=1.)  ``shape`` is ``(B, H, W, 3)``.
+
+    ``steps`` (default: ``timesteps``) subsets the dense grid via
+    :func:`sample_schedule_ts`.  All random draws — init image and the
+    stochastic-conditioning indices — stay on the SAME carried key stream
+    regardless of ``steps``; at ``steps == timesteps`` every array here is
+    bit-identical to the historical full-grid path, which is what keeps
+    the 256-step ancestral sampler usable as a parity oracle.
     """
-    ts = jnp.linspace(1.0, 0.0, timesteps + 1)
+    ts = sample_schedule_ts(steps, timesteps=timesteps)
+    n_steps = ts.shape[0] - 1
     logsnrs = logsnr_schedule_cosine(ts[:-1], logsnr_min=logsnr_min,
                                      logsnr_max=logsnr_max)
     logsnr_nexts = logsnr_schedule_cosine(ts[1:], logsnr_min=logsnr_min,
@@ -256,7 +325,7 @@ def sample_loop_prepare(*, record_len: jnp.ndarray, rng: jax.Array,
     # Pre-sampled stochastic-conditioning indices (reference
     # `random.choice(record)`, sampling.py:138) — computed up front so the
     # scan body is trace-static.
-    cond_idx = jax.random.randint(k_idx, (timesteps,), 0, record_len)
+    cond_idx = jax.random.randint(k_idx, (n_steps,), 0, record_len)
     return SampleState(init_img, rng), (logsnrs, logsnr_nexts, cond_idx)
 
 
@@ -264,9 +333,17 @@ def sample_loop_scan(denoise_fn: DenoiseFn, state: SampleState, xs, *,
                      record_imgs: jnp.ndarray, record_R: jnp.ndarray,
                      record_T: jnp.ndarray, target_R: jnp.ndarray,
                      target_T: jnp.ndarray, K: jnp.ndarray, w: jnp.ndarray,
-                     logsnr_max: float, clip_x0: bool) -> SampleState:
-    """``lax.scan`` the ancestral steps in ``xs`` from ``state`` (a full
-    run, or one chunk of it — see :func:`sample_loop_prepare`)."""
+                     logsnr_max: float, clip_x0: bool,
+                     deterministic: bool = False) -> SampleState:
+    """``lax.scan`` the reverse steps in ``xs`` from ``state`` (a full
+    run, or one chunk of it — see :func:`sample_loop_prepare`).
+
+    ``deterministic`` selects the DDIM (eta=0) update instead of the
+    ancestral one.  Both branches split the carried rng identically
+    (``rng, k_x, k_noise``) so the uncond-frame draws and the downstream
+    key stream are shared between samplers at matched seeds — the DDIM
+    path simply never consumes ``k_noise``.
+    """
     B = w.shape[0]
 
     Kb = jnp.broadcast_to(K[None], (B, 3, 3))
@@ -297,15 +374,21 @@ def sample_loop_scan(denoise_fn: DenoiseFn, state: SampleState, xs, *,
         eps = denoise_fn(batch, w_mask_2b)
         eps_cond, eps_uncond = eps[:B], eps[B:]
 
-        mean, var = p_mean_variance(
-            eps_cond, eps_uncond, state.img, logsnr, logsnr_next,
-            w.astype(state.img.dtype), clip_x0=clip_x0)
-        noise = jax.random.normal(k_noise, state.img.shape, state.img.dtype)
-        # Reference guard `if logsnr_next == 0: return mean`
-        # (train.py:125-126) — kept for parity even though the schedule's
-        # min logsnr is -20, so it never fires there.
-        img = jnp.where(logsnr_next == 0.0, mean,
-                        mean + jnp.sqrt(var) * noise)
+        if deterministic:
+            img = ddim_step(
+                eps_cond, eps_uncond, state.img, logsnr, logsnr_next,
+                w.astype(state.img.dtype), clip_x0=clip_x0)
+        else:
+            mean, var = p_mean_variance(
+                eps_cond, eps_uncond, state.img, logsnr, logsnr_next,
+                w.astype(state.img.dtype), clip_x0=clip_x0)
+            noise = jax.random.normal(
+                k_noise, state.img.shape, state.img.dtype)
+            # Reference guard `if logsnr_next == 0: return mean`
+            # (train.py:125-126) — kept for parity even though the
+            # schedule's min logsnr is -20, so it never fires there.
+            img = jnp.where(logsnr_next == 0.0, mean,
+                            mean + jnp.sqrt(var) * noise)
         return SampleState(img, rng), None
 
     state, _ = jax.lax.scan(step, state, xs)

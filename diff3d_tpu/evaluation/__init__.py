@@ -1,6 +1,7 @@
 from diff3d_tpu.evaluation.metrics import psnr, ssim
 from diff3d_tpu.evaluation.fid import (FIDStats, fid_from_stats,
                                        gaussian_stats, frechet_distance)
+from diff3d_tpu.evaluation.parity import PSNR_CAP, matched_seed_parity
 
 __all__ = ["psnr", "ssim", "FIDStats", "fid_from_stats", "gaussian_stats",
-           "frechet_distance"]
+           "frechet_distance", "PSNR_CAP", "matched_seed_parity"]

@@ -58,8 +58,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from diff3d_tpu.config import Config
-from diff3d_tpu.diffusion import (sample_loop_prepare, sample_loop_scan,
-                                  sample_view, sample_view_commit)
+from diff3d_tpu.diffusion import (SAMPLER_KINDS, sample_loop_prepare,
+                                  sample_loop_scan, sample_view,
+                                  sample_view_commit)
 from diff3d_tpu.models import XUNet
 
 
@@ -120,20 +121,40 @@ class Sampler:
         its lane counts).  With ``cfg.mesh.context_parallel`` on, the
         single-object path additionally threads
         ``MeshEnv.activation_constraint()`` through the model.
+      sampler_kind: reverse-process update — ``"ancestral"`` (the paper's
+        stochastic sampler) or ``"ddim"`` (deterministic eta=0).
+      steps: number of reverse steps per view; must divide
+        ``cfg.diffusion.timesteps`` (the k-step grid is an exact subset
+        of the dense grid — see
+        :func:`~diff3d_tpu.diffusion.sample_schedule_ts`).  ``None``
+        (default) runs the full grid, bit-identical to the historical
+        sampler.
     """
 
     def __init__(self, model: XUNet, params, cfg: Config,
-                 scan_chunks: int = 1, mesh=None):
+                 scan_chunks: int = 1, mesh=None,
+                 sampler_kind: str = "ancestral",
+                 steps: Optional[int] = None):
         self.model = model
         self.cfg = cfg
         self.mesh = mesh
         self.w = jnp.asarray(cfg.diffusion.guidance_weights, jnp.float32)
 
         d = cfg.diffusion
-        if scan_chunks < 1 or d.timesteps % scan_chunks:
+        if sampler_kind not in SAMPLER_KINDS:
             raise ValueError(
-                f"scan_chunks={scan_chunks} must divide "
+                f"sampler_kind={sampler_kind!r} not in {SAMPLER_KINDS}")
+        self.sampler_kind = sampler_kind
+        steps = d.timesteps if steps is None else int(steps)
+        if steps < 1 or d.timesteps % steps:
+            raise ValueError(
+                f"steps={steps} must be a positive divisor of "
                 f"timesteps={d.timesteps}")
+        self.steps = steps
+        if scan_chunks < 1 or steps % scan_chunks:
+            raise ValueError(
+                f"scan_chunks={scan_chunks} must divide the effective "
+                f"step count steps={steps}")
         self.scan_chunks = scan_chunks
 
         # Sharding vocabulary.  lane_multiple is the divisibility quantum
@@ -174,7 +195,8 @@ class Sampler:
                 record_R=record_R, record_T=record_T,
                 record_len=record_len, K=K, w=self.w, rng=rng,
                 timesteps=d.timesteps, logsnr_min=d.logsnr_min,
-                logsnr_max=d.logsnr_max, clip_x0=d.clip_x0)
+                logsnr_max=d.logsnr_max, clip_x0=d.clip_x0,
+                steps=self.steps, sampler_kind=self.sampler_kind)
 
         def _specs(data_sharding, n_data_args, n_outs):
             """jit sharding kwargs (empty off-mesh)."""
@@ -204,7 +226,8 @@ class Sampler:
                 state, xs = sample_loop_prepare(
                     record_len=record_len, rng=k, timesteps=d.timesteps,
                     shape=(self.w.shape[0],) + record_imgs.shape[-3:],
-                    logsnr_min=d.logsnr_min, logsnr_max=d.logsnr_max)
+                    logsnr_min=d.logsnr_min, logsnr_max=d.logsnr_max,
+                    steps=self.steps)
                 return state, xs, rng
 
             def chunk_view(params, state, xs, record_imgs, record_R,
@@ -214,9 +237,10 @@ class Sampler:
                     record_imgs=record_imgs, record_R=record_R,
                     record_T=record_T, target_R=record_R[record_len],
                     target_T=record_T[record_len], K=K, w=self.w,
-                    logsnr_max=d.logsnr_max, clip_x0=d.clip_x0)
+                    logsnr_max=d.logsnr_max, clip_x0=d.clip_x0,
+                    deterministic=(self.sampler_kind == "ddim"))
 
-            n_per = d.timesteps // scan_chunks
+            n_per = self.steps // scan_chunks
             sh = {} if mesh is None else {"out_shardings": self._rep}
             jit_prepare = jax.jit(
                 prepare_view,
@@ -282,7 +306,7 @@ class Sampler:
                 **({} if mesh is None
                    else {"in_shardings": (self._obj,) * 3,
                          "out_shardings": (self._obj,) * 3}))
-            n_per_many = d.timesteps // scan_chunks
+            n_per_many = self.steps // scan_chunks
 
             def run_view_many_chunked(params, record_imgs, record_R,
                                       record_T, record_len, K, rngs):
@@ -300,6 +324,13 @@ class Sampler:
                 return out, record_imgs, record_len, rngs
 
             self._run_view_many = run_view_many_chunked
+
+    @property
+    def model_calls_per_view(self) -> int:
+        """Denoiser invocations per synthesised view (each reverse step is
+        one 2B-batched CFG call) — the latency dial the step schedule
+        turns."""
+        return self.steps
 
     # ------------------------------------------------------------------
     # Per-view step API (public): one view's full reverse diffusion.

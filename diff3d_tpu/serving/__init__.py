@@ -20,7 +20,8 @@ from diff3d_tpu.serving.scheduler import (Bucket, EngineDraining,
                                           EngineOverloaded, EngineStepError,
                                           EngineStopped, QueueFullError,
                                           RequestCancelled, RequestTimeout,
-                                          Scheduler, ViewRequest)
+                                          Scheduler, UnsupportedSchedule,
+                                          ViewRequest)
 from diff3d_tpu.serving.server import ServingService, make_http_server
 
 __all__ = [
@@ -29,5 +30,5 @@ __all__ = [
     "HEALTH_DEGRADED", "HEALTH_DRAINING", "HEALTH_OK", "MetricsRegistry",
     "ParamsRegistry", "ProgramCache", "QueueFullError", "RequestCancelled",
     "RequestTimeout", "ResultCache", "Scheduler", "ServingService",
-    "ViewRequest", "make_http_server",
+    "UnsupportedSchedule", "ViewRequest", "make_http_server",
 ]

@@ -79,10 +79,26 @@ class ProgramCache:
 
     jax's jit cache holds the executables; first use of a new key is a
     trace+compile (timed and counted here), later uses are cache hits.
+
+    ``sampler`` may be a single :class:`~diff3d_tpu.sampling.Sampler` or
+    a dict ``{(sampler_kind, steps): Sampler}`` (the engine's schedule
+    registry, all sharing one params pytree): a bucket whose
+    ``steps``/``sampler`` fields are set routes to the matching sampler,
+    so the schedule rides the SAME key space as the shapes — no
+    on-demand sampler construction, no unbounded program variants.
     """
 
     def __init__(self, sampler, metrics=None):
-        self._sampler = sampler
+        if isinstance(sampler, dict):
+            if not sampler:
+                raise ValueError("ProgramCache: empty sampler dict")
+            self._samplers = dict(sampler)
+            self._sampler = next(iter(sampler.values()))
+        else:
+            self._samplers = {
+                (getattr(sampler, "sampler_kind", None),
+                 getattr(sampler, "steps", None)): sampler}
+            self._sampler = sampler
         self._lock = threading.Lock()
         self._programs: Dict[tuple, dict] = {}
         m = metrics
@@ -94,26 +110,53 @@ class ProgramCache:
             "view steps served by an already-compiled program") if m \
             else None
 
+    def _sampler_for(self, bucket):
+        """The sampler serving ``bucket``'s schedule (default sampler for
+        legacy 3-tuple buckets / unresolved schedules)."""
+        kind = getattr(bucket, "sampler", None)
+        steps = getattr(bucket, "steps", None)
+        if kind is None and steps is None:
+            return self._sampler
+        key = (kind if kind is not None
+               else getattr(self._sampler, "sampler_kind", None),
+               steps if steps is not None
+               else getattr(self._sampler, "steps", None))
+        try:
+            return self._samplers[key]
+        except KeyError:
+            raise KeyError(
+                f"no sampler for schedule {key} (bucket {tuple(bucket)}); "
+                "the engine should have rejected this at submit time")
+
+    @staticmethod
+    def _schedule_of(bucket) -> tuple:
+        return (getattr(bucket, "sampler", None),
+                getattr(bucket, "steps", None))
+
     def step_many(self, bucket, lanes: int, record_imgs, record_R,
                   record_T, steps, K, rngs, *, params=None):
         """Run one batched view step (device-resident signature: the pose
         buffers carry every view's pose, ``rngs`` are per-lane PRNG
         carries split inside).  Returns the sampler's full
         ``(out, record_imgs, steps + 1, rngs)`` carry tuple."""
+        sampler = self._sampler_for(bucket)
         key = (tuple(bucket), int(lanes))
         with self._lock:
             entry = self._programs.get(key)
             first = entry is None
             if first:
-                entry = self._programs[key] = {"compile_s": None, "uses": 0}
+                entry = self._programs[key] = {
+                    "compile_s": None, "uses": 0,
+                    "steps": getattr(sampler, "steps", None),
+                    "sampler": getattr(sampler, "sampler_kind", None)}
             entry["uses"] += 1
         if first and self._compiles:
             self._compiles.inc()
         if not first and self._hits:
             self._hits.inc()
         t0 = time.monotonic()
-        out = self._sampler.step_many(record_imgs, record_R, record_T,
-                                      steps, K, rngs, params=params)
+        out = sampler.step_many(record_imgs, record_R, record_T,
+                                steps, K, rngs, params=params)
         if first:
             out = jax.block_until_ready(out)
             with self._lock:
@@ -128,7 +171,7 @@ class ProgramCache:
         with self._lock:
             if key in self._programs:
                 return 0.0
-        H, W, cap = bucket
+        H, W, cap = tuple(bucket)[:3]
         N = int(lanes)
         t0 = time.monotonic()
         out = self.step_many(
@@ -143,16 +186,40 @@ class ProgramCache:
         jax.block_until_ready(out)
         return time.monotonic() - t0
 
+    def supported_schedules(self) -> list:
+        """Sorted ``"kind:steps"`` strings of the routable samplers."""
+        return sorted(
+            f"{k[0]}:{k[1]}" for k in self._samplers)
+
     def stats(self) -> dict:
+        default = (getattr(self._sampler, "sampler_kind", None),
+                   getattr(self._sampler, "steps", None))
+
+        def name(k):
+            b, lanes = k
+            s = f"H{b[0]}xW{b[1]}xcap{b[2]}"
+            kind, steps = (b[4], b[3]) if len(b) >= 5 else (None, None)
+            # Default-schedule buckets keep the legacy (schedule-free)
+            # name — dashboards keyed on it stay longitudinal; only
+            # non-default schedules grow a distinguishing segment.
+            if ((kind is not None or steps is not None)
+                    and (kind, steps) != default):
+                s += (f"x{kind or 'default'}"
+                      f"{steps if steps is not None else ''}")
+            return s + f"xlanes{lanes}"
+
         with self._lock:
             return {
                 "programs": {
-                    f"H{k[0][0]}xW{k[0][1]}xcap{k[0][2]}xlanes{k[1]}": {
+                    name(k): {
                         "uses": v["uses"],
                         "compile_s": v["compile_s"],
+                        "steps": v.get("steps"),
+                        "sampler": v.get("sampler"),
                     } for k, v in self._programs.items()
                 },
                 "num_programs": len(self._programs),
+                "supported_schedules": self.supported_schedules(),
             }
 
 

@@ -60,7 +60,8 @@ from diff3d_tpu.serving.metrics import MetricsRegistry
 from diff3d_tpu.serving.scheduler import (EngineDraining, EngineOverloaded,
                                           EngineStepError, EngineStopped,
                                           RequestCancelled, RequestTimeout,
-                                          Scheduler, ViewRequest)
+                                          Scheduler, UnsupportedSchedule,
+                                          ViewRequest)
 from diff3d_tpu.utils.profiling import StepTimer
 
 log = logging.getLogger(__name__)
@@ -127,15 +128,34 @@ class Engine:
                  metrics: MetricsRegistry, cfg: ServingConfig,
                  params_registry: Optional[ParamsRegistry] = None,
                  result_cache: Optional[ResultCache] = None,
-                 program_cache: Optional[ProgramCache] = None):
+                 program_cache: Optional[ProgramCache] = None,
+                 extra_samplers: Optional[dict] = None):
         self.sampler = sampler
         self.scheduler = scheduler
         self.metrics = metrics
         self.cfg = cfg
+        # Schedule registry: the replica serves exactly these
+        # (sampler_kind, steps) pairs — one Sampler each, all sharing the
+        # default sampler's params.  Requests naming any other schedule
+        # are rejected at submit with UnsupportedSchedule; programs are
+        # never compiled on client demand.
+        self.default_schedule = (getattr(sampler, "sampler_kind", None),
+                                 getattr(sampler, "steps", None))
+        self.samplers = {self.default_schedule: sampler}
+        for key, extra in (extra_samplers or {}).items():
+            kind, steps = key
+            self.samplers[(kind, None if steps is None
+                           else int(steps))] = extra
+            if (getattr(extra, "lane_multiple", 1)
+                    != getattr(sampler, "lane_multiple", 1)):
+                raise ValueError(
+                    f"extra sampler {key}: lane_multiple differs from the "
+                    "default sampler's — all schedules must share a mesh")
         self.registry = params_registry or ParamsRegistry(sampler.params)
         self.result_cache = result_cache or ResultCache(
             cfg.result_cache_entries, metrics)
-        self.programs = program_cache or ProgramCache(sampler, metrics)
+        self.programs = program_cache or ProgramCache(
+            self.samplers if len(self.samplers) > 1 else sampler, metrics)
         self.guidance_B = int(sampler.w.shape[0])
         # Mesh quantum: every launched lane count must divide by the
         # sampler's data-axis size, including the admission ceiling.
@@ -193,6 +213,10 @@ class Engine:
         self._stop_timeouts = m.counter(
             "serving_engine_stop_timeout_total",
             "stop() calls that leaked the worker thread")
+        self._sched_rejects = m.counter(
+            "serving_unsupported_schedule_total",
+            "submissions naming a (sampler_kind, steps) with no "
+            "compiled bucket")
         self._health_g = m.gauge(
             "serving_engine_health",
             "engine health (0=ok, 1=degraded, 2=draining)")
@@ -227,8 +251,33 @@ class Engine:
 
     # -- client surface --------------------------------------------------
 
+    def supported_schedules(self) -> List[str]:
+        """Sorted ``"kind:steps"`` strings this replica can serve."""
+        return sorted(f"{k[0]}:{k[1]}" for k in self.samplers)
+
     def submit(self, req: ViewRequest) -> ViewRequest:
-        """Schedule a request (or answer it from the result cache)."""
+        """Schedule a request (or answer it from the result cache).
+
+        The request's schedule is resolved here — ``None`` fields take
+        the replica default; a ``(sampler_kind, steps)`` outside the
+        schedule registry raises :class:`UnsupportedSchedule` (typed
+        retryable, carrying the supported list) instead of minting a new
+        compiled program variant on demand.
+        """
+        kind = (req.sampler_kind if req.sampler_kind is not None
+                else self.default_schedule[0])
+        steps = (req.steps if req.steps is not None
+                 else self.default_schedule[1])
+        if (kind, steps) not in self.samplers:
+            self._sched_rejects.inc()
+            raise UnsupportedSchedule(
+                f"{req.id}: schedule {kind}:{steps} has no compiled "
+                f"bucket on this replica (supported: "
+                f"{', '.join(self.supported_schedules())})",
+                supported=self.supported_schedules(),
+                retry_after_s=self.cfg.retry_after_s)
+        if kind is not None and steps is not None:
+            req.resolve_schedule(kind, steps)
         version, _ = self.registry.current()
         key = req.content_key(version)
         hit = self.result_cache.get(key)
@@ -333,6 +382,9 @@ class Engine:
                 "step_timer": self.step_timer.summary(),
                 "program_cache": self.programs.stats(),
                 "result_cache_entries": len(self.result_cache),
+                "default_schedule": (
+                    f"{self.default_schedule[0]}:{self.default_schedule[1]}"),
+                "supported_schedules": self.supported_schedules(),
             }
         }
 

@@ -31,16 +31,28 @@ from typing import Callable, Deque, Dict, List, NamedTuple, Optional
 
 import numpy as np
 
+from diff3d_tpu.diffusion import SAMPLER_KINDS
 from diff3d_tpu.runtime.retry import RetryableError
 from diff3d_tpu.sampling import record_capacity
 
 
 class Bucket(NamedTuple):
-    """Shape key of a compiled view-step program (minus the lane count)."""
+    """Shape key of a compiled view-step program (minus the lane count).
+
+    ``steps`` / ``sampler`` extend the key to the *schedule* of the
+    compiled scan: a 16-step DDIM program and a 256-step ancestral one
+    differ in trip count and update rule, so they can never share a
+    compilation.  ``None`` (the defaults, kept for positional
+    compatibility) means "the engine's default schedule" — the engine
+    resolves them to concrete values at submit time, before any request
+    reaches the scheduler or the program cache.
+    """
 
     H: int
     W: int
     capacity: int
+    steps: Optional[int] = None
+    sampler: Optional[str] = None
 
 
 class QueueFullError(RuntimeError):
@@ -78,6 +90,20 @@ class EngineStopped(RetryableError):
     """Replica stopped before the request could run."""
 
 
+class UnsupportedSchedule(RetryableError):
+    """The request's ``(sampler_kind, steps)`` has no compiled program on
+    this replica.  Compiling on demand would let clients mint unbounded
+    program-cache variants, so the request is rejected with the replica's
+    ``supported`` schedules (a list of ``"kind:steps"`` strings) — a
+    router can resubmit to a replica that serves the schedule."""
+
+    def __init__(self, msg: str, *,
+                 supported: Optional[List[str]] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg, retry_after_s=retry_after_s)
+        self.supported = list(supported or [])
+
+
 _req_ids = itertools.count()
 
 
@@ -91,12 +117,19 @@ class ViewRequest:
     ``views`` is the ``all_views``-style dict: ``imgs [>=1, H, W, 3]``
     (only view 0 is consumed), ``R [n, 3, 3]``, ``T [n, 3]``,
     ``K [3, 3]``.
+
+    ``sampler_kind`` / ``steps`` select the reverse-process schedule;
+    ``None`` means "replica default" and is resolved by the engine at
+    submit time (:meth:`resolve_schedule`) — a request never queues with
+    an unresolved schedule.
     """
 
     def __init__(self, views: dict, seed: int = 0,
                  n_views: Optional[int] = None,
                  timeout_s: Optional[float] = None,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 sampler_kind: Optional[str] = None,
+                 steps: Optional[int] = None):
         imgs = np.asarray(views["imgs"], np.float32)
         R = np.asarray(views["R"], np.float32)
         T = np.asarray(views["T"], np.float32)
@@ -125,8 +158,19 @@ class ViewRequest:
         self.K = K
         self.seed = int(seed)
         self.timeout_s = timeout_s
+        if sampler_kind is not None and sampler_kind not in SAMPLER_KINDS:
+            raise ValueError(
+                f"sampler_kind={sampler_kind!r} not in {SAMPLER_KINDS}")
+        if steps is not None:
+            steps = int(steps)
+            if steps < 1:
+                raise ValueError(f"steps={steps} must be >= 1")
+        self.sampler_kind = sampler_kind
+        self.steps = steps
         H, W = imgs.shape[1:3]
-        self.bucket = Bucket(H, W, record_capacity(self.n_views))
+        self._HW = (H, W)
+        self.bucket = Bucket(H, W, record_capacity(self.n_views),
+                             steps, sampler_kind)
         self.id = request_id or f"req-{next(_req_ids)}"
 
         self.submit_time: Optional[float] = None
@@ -195,15 +239,28 @@ class ViewRequest:
             return False
         return (time.monotonic() if now is None else now) > self.deadline
 
+    def resolve_schedule(self, sampler_kind: str, steps: int) -> None:
+        """Fill in replica defaults and rebuild the bucket with a fully
+        concrete schedule.  Called by the engine at submit time, before
+        the request can reach the scheduler, result cache, or program
+        cache — so every queued request's bucket names the exact compiled
+        program that will serve it."""
+        self.sampler_kind = str(sampler_kind)
+        self.steps = int(steps)
+        H, W = self._HW
+        self.bucket = Bucket(H, W, record_capacity(self.n_views),
+                             self.steps, self.sampler_kind)
+
     def content_key(self, params_version: str, extra: str = "") -> str:
         """Content hash for the result cache: identical inputs + seed +
-        params version => identical output (the sampler is deterministic
-        given the key), so replays can skip the chip entirely."""
+        schedule + params version => identical output (the sampler is
+        deterministic given the key), so replays can skip the chip
+        entirely."""
         h = hashlib.sha256()
         for a in (self.imgs0, self.R, self.T, self.K):
             h.update(np.ascontiguousarray(a).tobytes())
-        h.update(f"|{self.seed}|{self.n_views}|{params_version}|{extra}"
-                 .encode())
+        h.update(f"|{self.seed}|{self.n_views}|{self.sampler_kind}"
+                 f"|{self.steps}|{params_version}|{extra}".encode())
         return h.hexdigest()
 
 

@@ -9,12 +9,19 @@ single engine thread owns every device call, so ``GET /healthz`` and
 Surface:
   * ``POST /synthesize`` — submit a job.  Body: ``{"views": {"imgs",
     "R", "T", "K"}, "seed": 0, "n_views"?: int, "timeout_s"?: float,
-    "block"?: bool}``.  ``block=true`` (default) waits for the result;
-    ``block=false`` returns ``202 {"id"}`` for later polling.
+    "block"?: bool, "sampler_kind"?: "ancestral"|"ddim",
+    "steps"?: int}``.  ``block=true`` (default) waits for the result;
+    ``block=false`` returns ``202 {"id"}`` for later polling.  A
+    ``(sampler_kind, steps)`` pair the replica has no compiled bucket
+    for is rejected ``503`` with the supported schedules.
   * ``GET /result/<id>`` — poll a submitted job.
-  * ``GET /healthz`` — liveness + engine/queue state.
+  * ``GET /healthz`` — liveness + engine/queue state (incl. supported
+    schedules).
   * ``GET /metrics`` — text exposition; ``/metrics?format=json`` for the
     structured snapshot.
+  * ``GET /stats`` — the structured snapshot (alias of
+    ``/metrics?format=json``): per-bucket program-cache entries carry
+    their step count and sampler kind.
 
 Backpressure maps to status codes, never to silent queuing: a full queue
 is ``429``, a request deadline is ``504``, a cancelled request ``409``,
@@ -73,7 +80,12 @@ class ServingService:
     :meth:`submit` in-process.
     """
 
-    def __init__(self, sampler, cfg: Config, params_version: str = "v0"):
+    def __init__(self, sampler, cfg: Config, params_version: str = "v0",
+                 extra_samplers: Optional[dict] = None):
+        """``extra_samplers`` maps ``(sampler_kind, steps)`` to extra
+        :class:`~diff3d_tpu.sampling.Sampler` instances (sharing the
+        default sampler's params) — the additional schedules this
+        replica serves beyond the default sampler's own."""
         cfg.serving.validate()
         self.cfg = cfg
         self.metrics = MetricsRegistry()
@@ -84,12 +96,17 @@ class ServingService:
             metrics=self.metrics)
         self.registry = ParamsRegistry(sampler.params,
                                        version=params_version)
+        samplers = {(getattr(sampler, "sampler_kind", None),
+                     getattr(sampler, "steps", None)): sampler,
+                    **(extra_samplers or {})}
         self.engine = Engine(
             sampler, self.scheduler, self.metrics, cfg.serving,
             params_registry=self.registry,
             result_cache=ResultCache(cfg.serving.result_cache_entries,
                                      self.metrics),
-            program_cache=ProgramCache(sampler, self.metrics))
+            program_cache=ProgramCache(
+                samplers if len(samplers) > 1 else sampler, self.metrics),
+            extra_samplers=extra_samplers)
         self._requests_lock = threading.Lock()
         self._requests: "OrderedDict[str, ViewRequest]" = OrderedDict()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -143,11 +160,14 @@ class ServingService:
                 raise ValueError(
                     f"n_views={n_views} exceeds the service ceiling "
                     f"{self.cfg.serving.max_views}")
+        steps = payload.get("steps")
         req = ViewRequest(
             {k: np.asarray(v) for k, v in payload["views"].items()},
             seed=int(payload.get("seed", 0)),
             n_views=n_views,
-            timeout_s=payload.get("timeout_s"))
+            timeout_s=payload.get("timeout_s"),
+            sampler_kind=payload.get("sampler_kind"),
+            steps=None if steps is None else int(steps))
         if req.n_views > self.cfg.serving.max_views:
             raise ValueError(
                 f"request spans {req.n_views} views, service ceiling is "
@@ -197,6 +217,7 @@ class ServingService:
             "params_version": self.registry.version,
             "lane_multiple": self.engine.lane_multiple,
             "max_batch": self.engine.max_batch,
+            "supported_schedules": self.engine.supported_schedules(),
         }
 
     def metrics_snapshot(self) -> dict:
@@ -244,6 +265,8 @@ def make_http_server(service: ServingService, host: str,
                     self._send_json(200, service.metrics_snapshot())
                 else:
                     self._send_text(200, service.metrics.exposition())
+            elif url.path == "/stats":
+                self._send_json(200, service.metrics_snapshot())
             elif url.path.startswith("/result/"):
                 req = service.get_request(url.path[len("/result/"):])
                 if req is None:

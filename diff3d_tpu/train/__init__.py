@@ -2,10 +2,13 @@ from diff3d_tpu.train.state import (TrainState, create_train_state,
                                     ema_decay_per_step, make_optimizer,
                                     warmup_schedule)
 from diff3d_tpu.train.step import make_train_step
+from diff3d_tpu.train.distill import (distill, distill_schedule,
+                                      make_distill_step)
 from diff3d_tpu.train.checkpoint import CheckpointManager
 from diff3d_tpu.train.trainer import Trainer
 
 __all__ = [
     "TrainState", "create_train_state", "make_optimizer", "warmup_schedule",
     "ema_decay_per_step", "make_train_step", "CheckpointManager", "Trainer",
+    "distill", "distill_schedule", "make_distill_step",
 ]

@@ -1,0 +1,252 @@
+"""Progressive distillation of the few-step DDIM sampler.
+
+Salimans & Ho (2022), adapted to the pose-conditional 3DiM denoiser: a
+student with a ``k``-step deterministic schedule is trained so that ONE
+student DDIM step matches TWO consecutive teacher DDIM steps (each of
+size ``1/(2k)``) from the same ``z_t``.  Halving rounds
+``256 -> 128 -> ... -> 16`` compound into a 16x cheaper sampler whose
+updates stay on the dense grid's logsnr subsets
+(:func:`diff3d_tpu.diffusion.sample_schedule_ts`), so the distilled
+checkpoints drop straight into ``Sampler(sampler_kind="ddim", steps=k)``
+and the serving schedule registry.
+
+Distillation is conditional-only (``cond_mask=True``, guidance ``w=0``):
+the student inherits CFG behaviour from its epsilon-parameterisation, and
+sampling-time guidance still works because the uncond branch rides the
+same network.  The loss is the truncated-SNR x-space loss from the paper:
+``max(SNR(t), 1) * ||x_tilde - x_hat||^2`` — at high noise the
+epsilon->x map is ill-conditioned, so weighting in x-space keeps the
+low-SNR tail from dominating.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Dict, Iterator, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from diff3d_tpu.config import Config
+from diff3d_tpu.data.images import dequantize
+from diff3d_tpu.diffusion import (alpha_sigma, ddim_step,
+                                  logsnr_schedule_cosine, make_model_batch,
+                                  q_sample)
+from diff3d_tpu.parallel import MeshEnv
+from diff3d_tpu.train.state import (TrainState, create_train_state,
+                                    ema_decay_per_step, make_optimizer,
+                                    warmup_schedule)
+
+log = logging.getLogger(__name__)
+
+DistillStepFn = Callable[
+    [TrainState, dict, Dict[str, jnp.ndarray], jax.Array, jnp.ndarray],
+    Tuple[TrainState, Dict[str, jnp.ndarray]]]
+
+
+def make_distill_step(model, cfg: Config, env: MeshEnv | None = None,
+                      donate: bool = True) -> DistillStepFn:
+    """Build ``(state, teacher_params, batch, rng, student_steps) ->
+    (state, metrics)`` for the halving rounds: the student's
+    ``student_steps``-step schedule against a teacher running
+    ``2 * student_steps`` DDIM steps.
+
+    ``student_steps`` is a TRACED scalar, not baked in: every round of
+    the 256 -> ... -> 16 ladder reuses ONE compiled step (the graph is
+    identical across rounds; only the signal-time grid constant changes),
+    so the driver pays a single compile instead of one per halving.
+    Validity (``2 * student_steps`` divides the dense grid) is the
+    driver's to check — see :func:`distill_schedule`.
+
+    ``batch`` has the trainer's shape contract (``imgs [B,2,H,W,3]``
+    uint8, ``R``, ``T``, ``K``); ``teacher_params`` is an argument (not a
+    closure) so successive rounds reuse nothing stale and shard like the
+    student's params.  ``rng`` is folded with the step counter as in
+    :func:`diff3d_tpu.train.step.make_train_step`.
+    """
+    dcfg = cfg.diffusion
+    tx = make_optimizer(cfg.train)
+    sched = warmup_schedule(cfg.train)
+    ema_decay = ema_decay_per_step(cfg.train)
+    constrain = (env.activation_constraint()
+                 if env is not None and cfg.mesh.context_parallel else None)
+
+    def logsnr_of(t):
+        return logsnr_schedule_cosine(t, logsnr_min=dcfg.logsnr_min,
+                                      logsnr_max=dcfg.logsnr_max)
+
+    def step_fn(state: TrainState, teacher_params,
+                batch: Dict[str, jnp.ndarray], rng: jax.Array,
+                student_steps: jnp.ndarray
+                ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        rng = jax.random.fold_in(rng, state.step)
+        k_i, k_noise = jax.random.split(rng)
+
+        imgs = dequantize(batch["imgs"])
+        x, z = imgs[:, 0], imgs[:, 1]
+        B = z.shape[0]
+        cond_mask = jnp.ones((B,), bool)
+        w0 = jnp.zeros((B,), z.dtype)
+
+        # Student signal times t = i/k, i ~ U{1..k}; the teacher crosses
+        # the same interval in two half-steps t -> t - 1/(2k) -> t - 1/k.
+        i = jax.random.randint(k_i, (B,), 1, student_steps + 1)
+        t = i.astype(z.dtype) / student_steps
+        logsnr_t = logsnr_of(t)
+        logsnr_mid = logsnr_of(t - 0.5 / student_steps)
+        logsnr_next = logsnr_of(t - 1.0 / student_steps)
+        lt = logsnr_t[:, None, None, None]
+        lm = logsnr_mid[:, None, None, None]
+        ln = logsnr_next[:, None, None, None]
+
+        noise = jax.random.normal(k_noise, z.shape, z.dtype)
+        z_t = q_sample(z, logsnr_t, noise)
+
+        def denoise(params, z_in, logsnr):
+            mb = make_model_batch(x, z_in, logsnr, batch["R"], batch["T"],
+                                  batch["K"], logsnr_max=dcfg.logsnr_max)
+            return model.apply({"params": params}, mb, cond_mask=cond_mask,
+                               deterministic=True, constrain=constrain)
+
+        # Two teacher DDIM steps; passing eps twice makes the CFG combine
+        # with w=0 the plain conditional prediction.
+        eps1 = denoise(teacher_params, z_t, logsnr_t)
+        z_mid = ddim_step(eps1, eps1, z_t, lt, lm, w0)
+        eps2 = denoise(teacher_params, z_mid, logsnr_mid)
+        z_next = ddim_step(eps2, eps2, z_mid, lm, ln, w0)
+
+        # The x0 the student must predict so that ITS one DDIM step lands
+        # on z_next (paper eq. 8): x~ = (z_next - (s_n/s_t) z_t)
+        #                               / (a_n - (s_n/s_t) a_t).
+        alpha_t, sigma_t = alpha_sigma(lt)
+        alpha_n, sigma_n = alpha_sigma(ln)
+        ratio = sigma_n / sigma_t
+        x_target = jax.lax.stop_gradient(
+            (z_next - ratio * z_t) / (alpha_n - ratio * alpha_t))
+
+        def loss_fn(params):
+            eps_hat = denoise(params, z_t, logsnr_t)
+            x_hat = (z_t - sigma_t * eps_hat) / alpha_t
+            per = jnp.mean(jnp.square(x_target - x_hat), axis=(1, 2, 3))
+            wgt = jnp.maximum(jnp.exp(logsnr_t), 1.0)   # truncated SNR
+            return jnp.mean(wgt * per)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        ema_params = jax.tree.map(
+            lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+            state.ema_params, params)
+        new_state = TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state, ema_params=ema_params)
+        metrics = {
+            "distill_loss": loss,
+            "lr": sched(state.step),
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    if env is None:
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    batch_sh = env.batch()
+    rep = env.replicated()
+    jitted = None
+
+    def sharded_step(state, teacher_params, batch, rng, student_steps):
+        nonlocal jitted
+        if jitted is None:
+            st_sh = env.state_shardings(state)
+            jitted = jax.jit(
+                step_fn,
+                in_shardings=(st_sh, env.params(teacher_params),
+                              jax.tree.map(lambda _: batch_sh, batch),
+                              rep, rep),
+                out_shardings=(st_sh, rep),
+                donate_argnums=(0,) if donate else ())
+        return jitted(state, teacher_params, batch, rng, student_steps)
+
+    return sharded_step
+
+
+def distill_schedule(timesteps: int, start_steps: int,
+                     final_steps: int) -> List[int]:
+    """The per-round student step counts ``[start/2, start/4, ...,
+    final]``; validates the halving chain stays on dense-grid divisors."""
+    start_steps, final_steps = int(start_steps), int(final_steps)
+    if start_steps < 2 or timesteps % start_steps:
+        raise ValueError(
+            f"start_steps={start_steps} must divide timesteps={timesteps}")
+    if final_steps < 1 or start_steps % final_steps:
+        raise ValueError(
+            f"final_steps={final_steps} must divide "
+            f"start_steps={start_steps}")
+    rounds = []
+    k = start_steps // 2
+    while k >= final_steps:
+        rounds.append(k)
+        k //= 2
+    if not rounds or rounds[-1] != final_steps:
+        raise ValueError(
+            f"start_steps={start_steps} cannot halve down to "
+            f"final_steps={final_steps} (need a power-of-two ratio)")
+    return rounds
+
+
+def distill(model, cfg: Config, teacher_params,
+            batches: Iterator[Dict[str, jnp.ndarray]], rng: jax.Array, *,
+            start_steps: int | None = None, final_steps: int = 16,
+            round_steps: int = 2000, workdir: str | None = None,
+            keep: int = 2, env: MeshEnv | None = None,
+            log_every: int = 100):
+    """Run the halving rounds; returns ``(params, history)``.
+
+    Per round: the student initialises from the current teacher
+    (:func:`diff3d_tpu.convert.progressive.init_student_from_teacher` —
+    a fresh copy, so donation in the step never aliases the teacher),
+    trains ``round_steps`` steps, then its EMA becomes the next round's
+    teacher.  With ``workdir`` each round lands in
+    ``<workdir>/steps_<k>/`` through the async ``full_sliced``
+    checkpoint path (constrained-link safe), force-saved and awaited
+    before the next round starts so a preempted run restarts from the
+    last finished round.
+
+    ``batches`` is any iterator yielding trainer-contract batches; it is
+    drained across rounds (``rounds * round_steps`` draws).
+    """
+    from diff3d_tpu.convert.progressive import init_student_from_teacher
+    from diff3d_tpu.train.checkpoint import CheckpointManager
+
+    rounds = distill_schedule(cfg.diffusion.timesteps,
+                              cfg.diffusion.timesteps
+                              if start_steps is None else start_steps,
+                              final_steps)
+    teacher = teacher_params
+    history = []
+    step_fn = make_distill_step(model, cfg, env=env)   # shared: one compile
+    for k in rounds:
+        k_arr = jnp.asarray(k, jnp.int32)
+        state = create_train_state(init_student_from_teacher(teacher),
+                                   cfg.train)
+        metrics = {}
+        for n in range(round_steps):
+            state, metrics = step_fn(state, teacher, next(batches), rng,
+                                     k_arr)
+            if log_every and (n + 1) % log_every == 0:
+                log.info("distill %d-step round: %d/%d loss=%.5f", k,
+                         n + 1, round_steps,
+                         float(metrics["distill_loss"]))
+        entry = {"student_steps": k, "round_steps": round_steps,
+                 "final_loss": float(metrics["distill_loss"])}
+        if workdir is not None:
+            ckpt_dir = os.path.join(workdir, f"steps_{k}")
+            mgr = CheckpointManager(ckpt_dir, keep=keep,
+                                    mode="full_sliced", async_writes=True)
+            mgr.save(state, force=True)
+            mgr.wait_until_finished()
+            entry["checkpoint"] = ckpt_dir
+        history.append(entry)
+        teacher = state.ema_params
+    return teacher, history

@@ -40,15 +40,25 @@ def pytest_addoption(parser):
         help="run the slow end-to-end tests (test_cli, test_multiprocess)")
 
 
+def _env_on(name):
+    return os.environ.get(name, "").lower() in ("1", "true", "yes")
+
+
 def pytest_collection_modifyitems(config, items):
     """Keep the default ``pytest -q`` under ~5 min: the two end-to-end
-    files (train->sample CLI roundtrip, 2-process pod) are opt-in."""
-    if (config.getoption("--runslow")
-            or os.environ.get("RUN_SLOW", "").lower() in ("1", "true",
-                                                          "yes")):
-        return
-    skip = pytest.mark.skip(
-        reason="slow end-to-end test; pass --runslow (or RUN_SLOW=1)")
-    for item in items:
-        if "slow" in item.keywords:
-            item.add_marker(skip)
+    files (train->sample CLI roundtrip, 2-process pod) are opt-in, as
+    are the ``distill`` soaks (multi-round progressive-distillation
+    ladders; the fast 2-round smoke stays in the default run)."""
+    run_all = config.getoption("--runslow") or _env_on("RUN_SLOW")
+    if not run_all:
+        skip = pytest.mark.skip(
+            reason="slow end-to-end test; pass --runslow (or RUN_SLOW=1)")
+        for item in items:
+            if "slow" in item.keywords:
+                item.add_marker(skip)
+    if not (run_all or _env_on("RUN_DISTILL")):
+        skip_d = pytest.mark.skip(
+            reason="distillation soak; pass --runslow (or RUN_DISTILL=1)")
+        for item in items:
+            if "distill" in item.keywords:
+                item.add_marker(skip_d)

@@ -113,6 +113,38 @@ def test_main_emits_backend_dial_timeout_record(monkeypatch, capsys):
     assert rec["value"] is None and "180s" in rec["detail"]
 
 
+def test_sampler_steps_sweep_structure():
+    """The few-step sweep record: one DDIM point per schedule, speedups
+    relative to the full-grid point, and the 16-step schedule showing at
+    least 8x fewer model calls per view than the 256-step one (it is
+    exactly 16x; the guard leaves slack only for future schedule
+    changes)."""
+    calls = []
+
+    def fake_bench(config, n_views, object_batch, use_mesh,
+                   sampler_kind, steps):
+        calls.append((config, sampler_kind, steps))
+        # Per-view time shrinking sub-linearly with the schedule, like
+        # real hardware (per-step overhead doesn't vanish).
+        return 0.004 * steps + 0.05, 1.0, 3
+
+    rec = bench._sampler_steps_sweep("srn64", bench_fn=fake_bench)
+    assert rec["metric"] == "sampler_steps_sweep_srn64"
+    assert [c[2] for c in calls] == [256, 64, 16, 8]
+    assert all(c[1] == "ddim" for c in calls)
+
+    points = {p["steps"]: p for p in rec["points"]}
+    assert set(points) == {256, 64, 16, 8}
+    assert points[256]["speedup_vs_256"] == 1.0
+    assert points[16]["speedup_vs_256"] > points[64]["speedup_vs_256"] > 1
+    # The acceptance pin: 16-step DDIM costs >= 8x fewer model calls.
+    assert (points[256]["model_calls_per_view"]
+            >= 8 * points[16]["model_calls_per_view"])
+    for p in rec["points"]:
+        assert p["sampler"] == "ddim"
+        assert p["sec_per_view"] > 0 and p["effective_views"] == 3
+
+
 def test_main_emits_parseable_json_when_backend_never_comes_up(
         monkeypatch, capsys):
     import json

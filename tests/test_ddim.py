@@ -1,0 +1,442 @@
+"""Few-step sampling: deterministic DDIM, schedule subsets, progressive
+distillation, and the serving schedule registry.
+
+The contracts pinned here:
+
+  * SCHEDULE SUBSET — every ``k``-step time grid is the exact stride
+    subset of the dense grid, and ``steps=None`` / ``steps=timesteps``
+    are BIT-identical (the 256-step ancestral sampler stays usable as a
+    parity oracle after the refactor).
+  * DDIM DETERMINISM — the eta=0 path is bit-reproducible at a fixed
+    seed, chunk-invariant (``scan_chunks`` never changes results), and
+    mesh-shardable to float tolerance.
+  * SERVING SCHEDULES — an engine serves exactly its compiled
+    ``(sampler_kind, steps)`` registry: unknown schedules are rejected
+    with a typed retryable error carrying the supported list (never an
+    on-demand compile), and a non-default schedule rides the bucket key
+    end to end through a sharded service.
+  * DISTILLATION — two halving rounds (4 -> 2 -> 1 on the tiny grid)
+    run through the async ``full_sliced`` checkpoint path and hand back
+    a student whose 1-step DDIM sampler produces finite images.
+"""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from diff3d_tpu.config import MeshConfig, ServingConfig
+from diff3d_tpu.config import test_config as make_tiny_config
+from diff3d_tpu.data import SyntheticDataset
+from diff3d_tpu.diffusion import (alpha_sigma, ddim_step,
+                                  sample_schedule_ts)
+from diff3d_tpu.evaluation import PSNR_CAP, matched_seed_parity
+from diff3d_tpu.models import XUNet
+from diff3d_tpu.parallel import make_mesh
+from diff3d_tpu.runtime.retry import RetryableError
+from diff3d_tpu.sampling import Sampler
+from diff3d_tpu.serving import (ServingService, UnsupportedSchedule,
+                                ViewRequest)
+from diff3d_tpu.train.trainer import init_params
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = make_tiny_config(imgsize=8, ch=8)
+    model = XUNet(cfg.model)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    ds = SyntheticDataset(num_objects=2, num_views=4, imgsize=8)
+    return cfg, model, params, ds
+
+
+def _mesh(data: int):
+    return make_mesh(MeshConfig(data_parallel=data, model_parallel=1),
+                     devices=jax.devices()[:data])
+
+
+# ---------------------------------------------------------------------------
+# Schedule subsets (pure math)
+# ---------------------------------------------------------------------------
+
+
+def test_sample_schedule_ts_is_exact_dense_grid_subset():
+    dense = np.asarray(sample_schedule_ts(None, timesteps=256))
+    assert dense.shape == (257,)
+    assert dense[0] == 1.0 and dense[-1] == 0.0
+    for k in (256, 64, 16, 8):
+        ts = np.asarray(sample_schedule_ts(k, timesteps=256))
+        assert ts.shape == (k + 1,)
+        # Exact index subset, not merely close: the few-step grid must
+        # hit logsnr values the dense grid also hits.
+        np.testing.assert_array_equal(ts, dense[:: 256 // k])
+    np.testing.assert_array_equal(
+        np.asarray(sample_schedule_ts(256, timesteps=256)), dense)
+
+
+def test_sample_schedule_ts_rejects_non_divisors():
+    for bad in (0, -1, 3, 5, 7, 17, 512):
+        with pytest.raises(ValueError, match="divisor"):
+            sample_schedule_ts(bad, timesteps=256)
+
+
+def test_ddim_step_matches_closed_form():
+    """eta=0 update against the formula written out by hand, including
+    the post-clip eps re-derivation."""
+    r = np.random.RandomState(0)
+    B, H = 3, 4
+    z = jnp.asarray(r.randn(B, H, H, 3).astype(np.float32)) * 2.0
+    eps_c = jnp.asarray(r.randn(B, H, H, 3).astype(np.float32))
+    eps_u = jnp.asarray(r.randn(B, H, H, 3).astype(np.float32))
+    w = jnp.asarray([0.0, 1.0, 3.0], jnp.float32)
+    logsnr, logsnr_next = jnp.asarray(-1.3), jnp.asarray(0.8)
+
+    got = np.asarray(ddim_step(eps_c, eps_u, z, logsnr, logsnr_next, w))
+
+    a, s = (np.sqrt(jax.nn.sigmoid(logsnr)),
+            np.sqrt(jax.nn.sigmoid(-logsnr)))
+    an, sn = (np.sqrt(jax.nn.sigmoid(logsnr_next)),
+              np.sqrt(jax.nn.sigmoid(-logsnr_next)))
+    wb = np.asarray(w)[:, None, None, None]
+    eps = (1 + wb) * np.asarray(eps_c) - wb * np.asarray(eps_u)
+    x0 = np.clip((np.asarray(z) - s * eps) / a, -1.0, 1.0)
+    eps2 = (np.asarray(z) - a * x0) / s
+    np.testing.assert_allclose(got, an * x0 + sn * eps2, atol=1e-5)
+
+    # Final step: logsnr_next at the schedule max -> sigma_next ~ 4.5e-5,
+    # so the update collapses to (clipped) x0 up to that residual noise
+    # coefficient, with no special-case guard.
+    final = np.asarray(ddim_step(eps_c, eps_u, z, logsnr,
+                                 jnp.asarray(20.0), w))
+    np.testing.assert_allclose(final, x0, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Sampler plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_validates_schedule(setup):
+    cfg, model, params, ds = setup
+    T = cfg.diffusion.timesteps
+    s = Sampler(model, params, cfg)
+    assert (s.sampler_kind, s.steps) == ("ancestral", T)
+    assert s.model_calls_per_view == T
+    s2 = Sampler(model, params, cfg, sampler_kind="ddim", steps=2)
+    assert s2.model_calls_per_view == 2
+    with pytest.raises(ValueError, match="divisor"):
+        Sampler(model, params, cfg, steps=3)
+    with pytest.raises(ValueError, match="sampler_kind"):
+        Sampler(model, params, cfg, sampler_kind="euler")
+    with pytest.raises(ValueError, match="divide"):
+        Sampler(model, params, cfg, steps=2, scan_chunks=4)
+
+
+def test_default_steps_bit_identical_to_explicit(setup):
+    """steps=None and steps=timesteps share one prepare path (stride 1):
+    the refactor must leave the historical full-grid sampler bit-exact —
+    this is what keeps ancestral-256 a parity oracle."""
+    cfg, model, params, ds = setup
+    v, key = ds.all_views(0), jax.random.PRNGKey(7)
+    for kind in ("ancestral", "ddim"):
+        ref = Sampler(model, params, cfg,
+                      sampler_kind=kind).synthesize(v, key, max_views=3)
+        got = Sampler(model, params, cfg, sampler_kind=kind,
+                      steps=cfg.diffusion.timesteps).synthesize(
+                          v, key, max_views=3)
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_ddim_is_deterministic_and_differs_from_ancestral(setup):
+    cfg, model, params, ds = setup
+    v, key = ds.all_views(0), jax.random.PRNGKey(11)
+    ddim = Sampler(model, params, cfg, sampler_kind="ddim")
+    a = ddim.synthesize(v, key, max_views=3)
+    b = ddim.synthesize(v, key, max_views=3)
+    np.testing.assert_array_equal(a, b)
+    assert np.isfinite(a).all()
+    anc = Sampler(model, params, cfg).synthesize(v, key, max_views=3)
+    assert not np.array_equal(a, anc)
+
+
+def test_ddim_chunked_scan_bit_parity(setup):
+    """scan_chunks only splits device executions; the carried-rng stream
+    makes the chunked DDIM run bit-identical to the monolithic scan."""
+    cfg, model, params, ds = setup
+    v, key = ds.all_views(1), jax.random.PRNGKey(3)
+    whole = Sampler(model, params, cfg, sampler_kind="ddim",
+                    steps=4).synthesize(v, key, max_views=3)
+    chunked = Sampler(model, params, cfg, sampler_kind="ddim", steps=4,
+                      scan_chunks=2).synthesize(v, key, max_views=3)
+    np.testing.assert_array_equal(chunked, whole)
+
+
+def test_ddim_sharded_matches_unsharded(setup):
+    """Few-step DDIM over a data=2 mesh: per-object results match the
+    unsharded runtime to float tolerance (same key stream; XLA may tile
+    differently, so not bitwise)."""
+    cfg, model, params, ds = setup
+    views = [ds.all_views(0), ds.all_views(1)]
+    keys = [jax.random.PRNGKey(1), jax.random.PRNGKey(2)]
+    ref = Sampler(model, params, cfg, sampler_kind="ddim",
+                  steps=2).synthesize_many(views, keys, max_views=3)
+    sharded = Sampler(model, params, cfg, sampler_kind="ddim", steps=2,
+                      mesh=_mesh(2))
+    got = sharded.synthesize_many(views, keys, max_views=3)
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Matched-seed parity metric
+# ---------------------------------------------------------------------------
+
+
+def test_matched_seed_parity_metric(setup):
+    cfg, model, params, ds = setup
+    v, key = ds.all_views(0), jax.random.PRNGKey(5)
+    oracle = Sampler(model, params, cfg).synthesize(v, key, max_views=3)
+    few = Sampler(model, params, cfg, sampler_kind="ddim",
+                  steps=2).synthesize(v, key, max_views=3)
+
+    self_par = matched_seed_parity([oracle], [oracle])
+    assert self_par["psnr"] == PSNR_CAP          # capped, not inf
+    assert self_par["views"] == 2
+    assert self_par["ssim"] == pytest.approx(1.0, abs=1e-4)
+
+    par = matched_seed_parity([few], [oracle])
+    assert par["views"] == 2
+    assert 0.0 < par["psnr"] <= PSNR_CAP
+    assert np.isfinite(par["ssim"])
+
+    with pytest.raises(ValueError, match="align"):
+        matched_seed_parity([few], [oracle, oracle])
+    with pytest.raises(ValueError, match="shape"):
+        matched_seed_parity([few[:1]], [oracle])
+
+
+# ---------------------------------------------------------------------------
+# Serving: schedule registry
+# ---------------------------------------------------------------------------
+
+
+def _serving_cfg(cfg, **kw):
+    return dataclasses.replace(cfg, serving=ServingConfig(
+        port=0, max_batch=4, max_queue=8, max_wait_ms=100.0, max_views=6,
+        default_timeout_s=120.0, **kw))
+
+
+def test_engine_rejects_unsupported_schedule(setup):
+    cfg, model, params, ds = setup
+    cfg = _serving_cfg(cfg)
+    sampler = Sampler(model, params, cfg)
+    service = ServingService(sampler, cfg).start(serve_http=False)
+    try:
+        v = ds.all_views(0)
+        req = ViewRequest(
+            {k: np.asarray(v[k]) for k in ("imgs", "R", "T", "K")},
+            seed=1, n_views=3, sampler_kind="ddim", steps=2)
+        with pytest.raises(UnsupportedSchedule) as ei:
+            service.engine.submit(req)
+        err = ei.value
+        assert isinstance(err, RetryableError)   # clients may retry
+        assert err.supported == ["ancestral:4"]  # elsewhere, that is
+        assert "ddim:2" in str(err)
+        snap = service.metrics_snapshot()
+        assert snap["counters"][
+            "serving_unsupported_schedule_total"] == 1
+        assert service.engine.supported_schedules() == ["ancestral:4"]
+    finally:
+        service.stop()
+
+
+def test_request_schedule_validation(setup):
+    cfg, model, params, ds = setup
+    v = {k: np.asarray(ds.all_views(0)[k])
+         for k in ("imgs", "R", "T", "K")}
+    with pytest.raises(ValueError, match="sampler_kind"):
+        ViewRequest(dict(v), seed=0, n_views=3, sampler_kind="euler")
+    with pytest.raises(ValueError, match="steps"):
+        ViewRequest(dict(v), seed=0, n_views=3, steps=0)
+    # Schedule participates in the result-cache content key: the same
+    # inputs under different schedules must never collide.
+    r_anc = ViewRequest(dict(v), seed=0, n_views=3)
+    r_ddim = ViewRequest(dict(v), seed=0, n_views=3,
+                         sampler_kind="ddim", steps=2)
+    assert r_anc.content_key("v0") != r_ddim.content_key("v0")
+
+
+def test_ddim_end_to_end_through_sharded_serving(setup):
+    """The acceptance pin: a ddim:2 request through scheduler -> engine ->
+    program cache on a data=2 mesh completes, matches the offline DDIM
+    sampler, and its schedule rides the bucket key (distinct compiled
+    program, schedule-suffixed stats name, supported_schedules surfaced
+    in health/stats)."""
+    cfg, model, params, ds = setup
+    cfg = _serving_cfg(cfg)
+    env = _mesh(2)
+    sampler = Sampler(model, params, cfg, mesh=env)
+    ddim2 = Sampler(model, params, cfg, mesh=env, sampler_kind="ddim",
+                    steps=2)
+    service = ServingService(
+        sampler, cfg,
+        extra_samplers={("ddim", 2): ddim2}).start(serve_http=False)
+    try:
+        assert service.engine.supported_schedules() == [
+            "ancestral:4", "ddim:2"]
+        assert service.health()["supported_schedules"] == [
+            "ancestral:4", "ddim:2"]
+        v = ds.all_views(1)
+        raw = {k: np.asarray(v[k]) for k in ("imgs", "R", "T", "K")}
+        req_d = ViewRequest(dict(raw), seed=9, n_views=3,
+                            sampler_kind="ddim", steps=2)
+        req_a = ViewRequest(dict(raw), seed=9, n_views=3)   # default
+        service.engine.submit(req_d)
+        service.engine.submit(req_a)
+        out_d = req_d.result(timeout=120)
+        out_a = req_a.result(timeout=120)
+
+        ref_d = Sampler(model, params, cfg, sampler_kind="ddim",
+                        steps=2).synthesize(v, jax.random.PRNGKey(9),
+                                            max_views=3)
+        ref_a = Sampler(model, params, cfg).synthesize(
+            v, jax.random.PRNGKey(9), max_views=3)
+        np.testing.assert_allclose(out_d, ref_d, atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(out_a, ref_a, atol=1e-5, rtol=1e-5)
+
+        stats = service.engine.programs.stats()
+        names = sorted(stats["programs"])
+        assert names == ["H8xW8xcap4xddim2xlanes2", "H8xW8xcap4xlanes2"]
+        ddim_entry = stats["programs"]["H8xW8xcap4xddim2xlanes2"]
+        assert (ddim_entry["steps"], ddim_entry["sampler"]) == (2, "ddim")
+        assert stats["supported_schedules"] == ["ancestral:4", "ddim:2"]
+    finally:
+        service.stop()
+
+
+def test_http_stats_endpoint_and_schedule_rejection(setup):
+    """GET /stats serves the structured snapshot (incl. schedules); a
+    POST naming an uncompiled schedule gets a typed 503 + Retry-After
+    with the supported list in the body."""
+    cfg, model, params, ds = setup
+    cfg = _serving_cfg(cfg)
+    sampler = Sampler(model, params, cfg)
+    service = ServingService(sampler, cfg).start(serve_http=True)
+    try:
+        port = service.port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=30) as r:
+            assert r.status == 200
+            snap = json.loads(r.read())
+        assert snap["engine"]["supported_schedules"] == ["ancestral:4"]
+        assert snap["engine"]["default_schedule"] == "ancestral:4"
+        assert "serving_unsupported_schedule_total" in snap["counters"]
+
+        v = ds.all_views(0)
+        payload = {"views": {k: np.asarray(v[k]).tolist()
+                             for k in ("imgs", "R", "T", "K")},
+                   "seed": 0, "n_views": 3,
+                   "sampler_kind": "ddim", "steps": 2}
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/synthesize", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 503
+        err = json.loads(ei.value.read())
+        assert "ancestral:4" in err["error"]
+    finally:
+        service.stop()
+
+
+# ---------------------------------------------------------------------------
+# Progressive distillation
+# ---------------------------------------------------------------------------
+
+
+def _distill_batches(H, B=1, seed=0):
+    r = np.random.RandomState(seed)
+    while True:
+        yield {
+            "imgs": r.randint(0, 256, (B, 2, H, H, 3)).astype(np.uint8),
+            "R": np.broadcast_to(np.eye(3, dtype=np.float32),
+                                 (B, 2, 3, 3)).copy(),
+            "T": r.randn(B, 2, 3).astype(np.float32),
+            "K": np.broadcast_to(
+                np.array([[H * 1.2, 0, H / 2], [0, H * 1.2, H / 2],
+                          [0, 0, 1]], np.float32), (B, 3, 3)).copy(),
+        }
+
+
+def test_distill_schedule_validation():
+    from diff3d_tpu.train import distill_schedule
+
+    assert distill_schedule(256, 256, 16) == [128, 64, 32, 16]
+    assert distill_schedule(4, 4, 1) == [2, 1]
+    with pytest.raises(ValueError, match="divide"):
+        distill_schedule(4, 3, 1)
+    with pytest.raises(ValueError, match="divide"):
+        distill_schedule(256, 256, 24)
+
+
+def test_distill_two_rounds_smoke(tmp_path):
+    """4 -> 2 -> 1 on the shallow tiny model: both rounds run, each lands
+    an async full_sliced checkpoint, and the final 1-step student drives
+    a working DDIM sampler."""
+    from diff3d_tpu.train import distill
+
+    cfg = make_tiny_config(imgsize=8, ch=8, shallow=True)
+    model = XUNet(cfg.model)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+
+    final, history = distill(
+        model, cfg, params, _distill_batches(cfg.model.H),
+        jax.random.PRNGKey(1), final_steps=1, round_steps=2,
+        workdir=str(tmp_path), log_every=0)
+
+    assert [h["student_steps"] for h in history] == [2, 1]
+    for h in history:
+        assert np.isfinite(h["final_loss"])
+        ckpt = tmp_path / f"steps_{h['student_steps']}"
+        assert h["checkpoint"] == str(ckpt)
+        marker = json.loads((ckpt / "ckpt_format.json").read_text())
+        assert marker["mode"] == "full_sliced"
+        assert (ckpt / "2").is_dir()             # round_steps saved step
+
+    # The distilled output is a different set of weights...
+    assert any(
+        not np.array_equal(a, b) for a, b in
+        zip(jax.tree.leaves(final), jax.tree.leaves(params)))
+    # ...that still runs the few-step sampler it was distilled for.
+    ds = SyntheticDataset(num_objects=1, num_views=3, imgsize=8)
+    out = Sampler(model, final, cfg, sampler_kind="ddim",
+                  steps=1).synthesize(ds.all_views(0),
+                                      jax.random.PRNGKey(2), max_views=3)
+    assert out.shape[0] == 2 and np.isfinite(out).all()
+
+
+@pytest.mark.distill
+@pytest.mark.slow
+def test_distill_full_ladder_long(tmp_path):
+    """Longer soak (opt-in): the full 4-round ladder on a 16-step grid
+    with more steps per round; every round checkpoints and stays
+    finite."""
+    from diff3d_tpu.train import distill
+
+    cfg = make_tiny_config(imgsize=8, ch=8, shallow=True)
+    cfg = dataclasses.replace(
+        cfg, diffusion=dataclasses.replace(cfg.diffusion, timesteps=16))
+    model = XUNet(cfg.model)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    final, history = distill(
+        model, cfg, params, _distill_batches(cfg.model.H, B=2),
+        jax.random.PRNGKey(1), final_steps=1, round_steps=16,
+        workdir=str(tmp_path), log_every=0)
+    assert [h["student_steps"] for h in history] == [8, 4, 2, 1]
+    assert all(np.isfinite(h["final_loss"]) for h in history)
+    assert all((tmp_path / f"steps_{k}").is_dir() for k in (8, 4, 2, 1))
+    assert all(np.isfinite(l).all() for l in jax.tree.leaves(final))

@@ -67,7 +67,8 @@ def _build_service(args):
         mesh_env = make_mesh(cfg.mesh)
         print(f"bench_serving: mesh {dict(mesh_env.mesh.shape)} "
               f"(lane multiple {mesh_env.data_size})", file=sys.stderr)
-    sampler = Sampler(model, params, cfg, mesh=mesh_env)
+    sampler = Sampler(model, params, cfg, mesh=mesh_env,
+                      sampler_kind=args.sampler, steps=args.sampler_steps)
     return sampler, cfg
 
 
@@ -100,8 +101,10 @@ def _run_rate(sampler, cfg, rate: float, args) -> dict:
     # to the mesh's lane multiple) so the warmed shapes are exactly the
     # ones traffic will launch.
     from diff3d_tpu.sampling import record_capacity
+    from diff3d_tpu.serving import Bucket
     from diff3d_tpu.serving.engine import lane_count
-    bucket = (cfg.model.H, cfg.model.W, record_capacity(args.n_views))
+    bucket = Bucket(cfg.model.H, cfg.model.W, record_capacity(args.n_views),
+                    sampler.steps, sampler.sampler_kind)
     eng = service.engine
     for lanes in {lane_count(1, eng.max_batch, eng.lane_multiple),
                   lane_count(min(eng.max_batch, args.requests or 1),
@@ -186,6 +189,14 @@ def main(argv=None) -> int:
                    help="views per request (incl. the conditioning view)")
     p.add_argument("--steps", type=int, default=None,
                    help="diffusion steps per view (test config: 4)")
+    p.add_argument("--sampler", choices=["ancestral", "ddim"],
+                   default="ancestral",
+                   help="reverse-process update served by the engine")
+    p.add_argument("--sampler_steps", type=int, default=None,
+                   help="few-step schedule: reverse steps per view, a "
+                        "divisor of the dense grid (default = full grid) "
+                        "— e.g. --sampler ddim --sampler_steps 16 vs the "
+                        "256-step default for an end-to-end comparison")
     p.add_argument("--max_batch", type=int, default=8)
     p.add_argument("--max_queue", type=int, default=256)
     p.add_argument("--max_wait_ms", type=float, default=50.0)
@@ -217,6 +228,8 @@ def main(argv=None) -> int:
         "mesh": bool(args.mesh),
         "lane_multiple": sampler.lane_multiple,
         "diffusion_steps": cfg.diffusion.timesteps,
+        "sampler": sampler.sampler_kind,
+        "sampler_steps": sampler.steps,
         "n_views": args.n_views,
         "max_batch": args.max_batch,
         "max_wait_ms": args.max_wait_ms,
