@@ -1,0 +1,26 @@
+"""Static analysis + runtime invariants for the TPU hot paths.
+
+Two halves, one contract (DESIGN.md §9):
+
+  * ``analysis.lint`` — graftlint, the AST tracer-hygiene linter
+    (``python -m diff3d_tpu.analysis`` walks diff3d_tpu/, tools/ and
+    bench.py and exits nonzero on unsuppressed findings; tier 1 runs it
+    as a gate);
+  * ``analysis.runtime`` — the recompilation sentinel, transfer/donation
+    guards and the ``compile_budget`` pytest marker that enforce the
+    same invariants on running code.
+"""
+
+from diff3d_tpu.analysis.lint import (Finding, lint_paths, lint_source,
+                                      main)
+from diff3d_tpu.analysis.runtime import (CompileBudgetExceeded,
+                                         RecompilationSentinel,
+                                         assert_consumed, assert_live,
+                                         compile_budget,
+                                         no_host_transfers, owned)
+
+__all__ = [
+    "Finding", "lint_paths", "lint_source", "main",
+    "RecompilationSentinel", "CompileBudgetExceeded", "compile_budget",
+    "no_host_transfers", "assert_consumed", "assert_live", "owned",
+]

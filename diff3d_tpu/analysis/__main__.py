@@ -1,0 +1,8 @@
+"""``python -m diff3d_tpu.analysis`` — run graftlint (DESIGN.md §9)."""
+
+import sys
+
+from diff3d_tpu.analysis.lint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+"""pytest integration for the runtime invariant harness.
+
+Loaded via ``addopts = "-p diff3d_tpu.analysis.pytest_plugin"`` in
+``pyproject.toml`` (works from a checkout without installing the
+package — pytest resolves the module off ``sys.path``).  Exposes:
+
+  * ``@pytest.mark.compile_budget(n)`` — the test's tracked jitted
+    callables may compile at most ``n`` programs.  The test requests the
+    ``compile_sentinel`` fixture, registers the callables it exercises
+    with :meth:`RecompilationSentinel.track`, and the budget is enforced
+    at teardown (after the test body, so every dispatch is counted).
+    A marked test that never tracks anything fails — a budget over zero
+    callables would vacuously pass.
+  * ``compile_sentinel`` — a fresh :class:`RecompilationSentinel` per
+    test, usable with or without the marker.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from diff3d_tpu.analysis.runtime import RecompilationSentinel
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "compile_budget(n): the test's callables tracked via the "
+        "compile_sentinel fixture may compile at most n programs "
+        "(enforced at teardown)")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_runtest_setup(item):
+    marker = item.get_closest_marker("compile_budget")
+    if marker is None:
+        return
+    if not marker.args or not isinstance(marker.args[0], int):
+        pytest.fail(
+            f"{item.nodeid}: @pytest.mark.compile_budget needs an "
+            "integer budget, e.g. compile_budget(1)", pytrace=False)
+    if "compile_sentinel" not in item.fixturenames:
+        pytest.fail(
+            f"{item.nodeid}: @pytest.mark.compile_budget requires the "
+            "compile_sentinel fixture — request it and track the "
+            "jitted callables under test", pytrace=False)
+
+
+@pytest.fixture
+def compile_sentinel(request):
+    sentinel = RecompilationSentinel()
+    yield sentinel
+    marker = request.node.get_closest_marker("compile_budget")
+    if marker is None:
+        return
+    if not sentinel.counts() and marker.args[0] >= 0:
+        pytest.fail(
+            f"{request.node.nodeid}: compile_budget({marker.args[0]}) "
+            "but the sentinel tracked no callables — the budget would "
+            "pass vacuously; call compile_sentinel.track(...)",
+            pytrace=False)
+    sentinel.assert_budget(marker.args[0])

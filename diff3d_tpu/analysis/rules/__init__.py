@@ -1,0 +1,36 @@
+"""graftlint rule registry.
+
+Rule IDs are stable API (suppression comments and the baseline reference
+them):
+
+  GL001  parse-error            file does not parse (engine-emitted)
+  GL002  reasonless-suppression suppression without a (reason)
+  GL101  rng-key-reuse          PRNG key consumed twice without split
+  GL102  traced-python-branch   Python if/while on a traced value
+  GL103  host-sync-in-jit       .item()/np.asarray/device_get in trace
+  GL104  donated-buffer-reuse   read after donate_argnums donation
+  GL105  missing-static-argnums shape-like jit param left traced
+  GL106  unsynced-timing        timing device work without sync
+  GL107  mutable-trace-state    mutable defaults / global in trace
+"""
+
+from diff3d_tpu.analysis.rules.donation import DonatedReuseRule
+from diff3d_tpu.analysis.rules.jit_args import StaticShapeArgRule
+from diff3d_tpu.analysis.rules.rng import RngReuseRule
+from diff3d_tpu.analysis.rules.state import MutableTraceStateRule
+from diff3d_tpu.analysis.rules.timing import UnsyncedTimingRule
+from diff3d_tpu.analysis.rules.tracing import HostSyncRule, TracedBranchRule
+
+ALL_RULES = (
+    RngReuseRule(),
+    TracedBranchRule(),
+    HostSyncRule(),
+    DonatedReuseRule(),
+    StaticShapeArgRule(),
+    UnsyncedTimingRule(),
+    MutableTraceStateRule(),
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_ID"]

@@ -1,0 +1,37 @@
+"""Rule protocol for graftlint.
+
+A rule is a stateless object with an ``id`` (``GLnnn``), a short
+``name``, a default ``severity``, and ``check(ctx) -> Iterator[Finding]``
+over one :class:`~diff3d_tpu.analysis.rules.context.ModuleContext`.
+Rules must be conservative: an unsuppressed false positive blocks the
+tier-1 gate, so when a pattern is ambiguous the rule stays silent — the
+runtime harness (``analysis/runtime.py``) catches what static analysis
+declines to guess at.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from diff3d_tpu.analysis.rules.context import ModuleContext
+
+
+class Rule:
+    id: str = "GL000"
+    name: str = "abstract"
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterator["Finding"]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, node: ast.AST,
+                message: str, severity: str = None):
+        from diff3d_tpu.analysis.lint import Finding
+        return Finding(
+            path=ctx.path, rule=self.id,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            severity=severity or self.severity,
+            message=message)

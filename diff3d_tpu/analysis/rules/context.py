@@ -1,0 +1,383 @@
+"""Shared AST analysis for the lint rules.
+
+One :class:`ModuleContext` is built per linted file and handed to every
+rule, so the expensive whole-module passes (symbol tables, the jit
+registry, traced-context discovery) run once.
+
+Two vocabulary items every rule leans on:
+
+  * the **jit registry** — every ``jax.jit``/``pjit`` call site in the
+    module, with the wrapped function resolved to its local ``def`` /
+    ``lambda`` when possible, plus the ``static_argnums`` /
+    ``static_argnames`` / ``donate_argnums`` it was compiled with and the
+    name(s) the jitted callable was bound to (``f = jax.jit(...)`` or
+    ``self._f = jax.jit(...)``);
+  * **traced contexts** — function nodes whose *parameters are tracers*
+    when they run: jit-decorated/jit-wrapped functions and the body
+    functions handed to ``lax.scan`` / ``while_loop`` / ``fori_loop`` /
+    ``cond`` / ``vmap`` / ``pmap`` / ``grad``, plus every ``def`` nested
+    inside one.  Rules deliberately do NOT propagate "traced" through
+    ordinary call edges — a helper called from a traced function often
+    receives concrete Python values (config flags, shapes), and flagging
+    its ``if``s would drown the gate in false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: Callables whose function-valued first argument runs under trace.
+TRACING_ENTRY_POINTS = {
+    "jax.jit", "jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit",
+    "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.grad", "grad", "jax.value_and_grad", "value_and_grad",
+    "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop", "jax.lax.cond", "lax.cond",
+    "jax.checkpoint", "jax.remat",
+}
+
+#: The subset that is a jit boundary (static/donate argnums apply).
+JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit",
+             "jax.experimental.pjit.pjit"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _unwrap_partial(call: ast.Call) -> Optional[ast.Call]:
+    """``partial(jax.jit, ...)`` -> a synthetic view of the jit call."""
+    name = dotted_name(call.func)
+    if name in ("functools.partial", "partial") and call.args:
+        inner = dotted_name(call.args[0])
+        if inner in JIT_NAMES or inner in TRACING_ENTRY_POINTS:
+            synthetic = ast.Call(func=call.args[0], args=call.args[1:],
+                                 keywords=call.keywords)
+            ast.copy_location(synthetic, call)
+            return synthetic
+    return None
+
+
+def _int_elements(node: ast.AST) -> Tuple[int, ...]:
+    """Integer literals of an int / tuple / list literal (else empty)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _str_elements(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def param_names(fn: ast.AST) -> List[str]:
+    """Positional parameter names of a def/lambda (self excluded)."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+        return []
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One ``jax.jit(...)`` call site."""
+
+    call: ast.Call
+    #: resolved wrapped function node (def/lambda), when local.
+    fn: Optional[ast.AST]
+    static_argnums: Tuple[int, ...]
+    static_argnames: Tuple[str, ...]
+    donate_argnums: Tuple[int, ...]
+    #: names the jitted callable is bound to: plain names and, for
+    #: ``self.x = jax.jit(...)``, the attribute name (matched by attr).
+    bound_names: Tuple[str, ...] = ()
+    bound_attrs: Tuple[str, ...] = ()
+
+
+class _ScopeCollector(ast.NodeVisitor):
+    """name -> def node, per enclosing scope chain (module + functions)."""
+
+    def __init__(self):
+        self.defs: Dict[int, Dict[str, ast.AST]] = {}
+        self._stack: List[ast.AST] = []
+
+    def visit_Module(self, node):
+        self._stack.append(node)
+        self.defs[id(node)] = {}
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_fn(self, node):
+        self.defs[id(self._stack[-1])][node.name] = node
+        self._stack.append(node)
+        self.defs[id(node)] = {}
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_ClassDef(self, node):
+        # Methods live in the class namespace; rules only ever resolve
+        # plain names, so class scopes are transparent here.
+        self.generic_visit(node)
+
+    def visit_Lambda(self, node):
+        self._stack.append(node)
+        self.defs[id(node)] = {}
+        self.generic_visit(node)
+        self._stack.pop()
+
+
+class ModuleContext:
+    """Everything the rules share about one parsed module."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+
+        # Parent links (ast has none) + source-ordered node walk.
+        self.parent: Dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[id(child)] = node
+
+        # Scope chains for name -> local def resolution.
+        collector = _ScopeCollector()
+        collector.visit(tree)
+        self._scope_defs = collector.defs
+
+        # Aliases of the jax.random module ("jr", "random", ...).
+        self.random_aliases: Set[str] = {"jax.random"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.random":
+                        self.random_aliases.add(a.asname or "jax.random")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "random":
+                            self.random_aliases.add(a.asname or "random")
+                elif node.module == "jax.random":
+                    pass  # direct function imports handled by callers
+
+        self.jit_sites: List[JitSite] = []
+        self._collect_jit_sites()
+        self.traced_functions: Set[int] = set()
+        self._traced_nodes: List[ast.AST] = []
+        self._collect_traced()
+
+    # -- scope / name resolution ---------------------------------------
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parent.get(id(node))
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parent.get(id(cur))
+        return None
+
+    def resolve_local(self, node: ast.AST,
+                      name: str) -> Optional[ast.AST]:
+        """The def bound to ``name`` visible from ``node``'s scope."""
+        scope = self.enclosing_function(node)
+        while True:
+            defs = self._scope_defs.get(id(scope if scope is not None
+                                            else self.tree), {})
+            if name in defs:
+                return defs[name]
+            if scope is None:
+                return None
+            scope = self.enclosing_function(scope)
+            if scope is None:
+                defs = self._scope_defs.get(id(self.tree), {})
+                return defs.get(name)
+
+    # -- jit registry ---------------------------------------------------
+
+    def _collect_jit_sites(self) -> None:
+        # Decorator form first: @jax.jit / @partial(jax.jit, ...) on a
+        # def associates the site with the decorated function itself.
+        decorated: Set[int] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for deco in node.decorator_list:
+                call = deco if isinstance(deco, ast.Call) else None
+                if call is not None:
+                    name = dotted_name(call.func)
+                    if name not in JIT_NAMES:
+                        call = _unwrap_partial(call)
+                        if (call is None
+                                or dotted_name(call.func)
+                                not in JIT_NAMES):
+                            continue
+                elif dotted_name(deco) in JIT_NAMES:
+                    call = ast.Call(func=deco, args=[], keywords=[])
+                    ast.copy_location(call, deco)
+                else:
+                    continue
+                decorated.add(id(deco))
+                static_nums: Tuple[int, ...] = ()
+                static_names: Tuple[str, ...] = ()
+                donate: Tuple[int, ...] = ()
+                for kw in call.keywords:
+                    if kw.arg == "static_argnums":
+                        static_nums = _int_elements(kw.value)
+                    elif kw.arg == "static_argnames":
+                        static_names = _str_elements(kw.value)
+                    elif kw.arg in ("donate_argnums", "donate_argnames"):
+                        donate = _int_elements(kw.value)
+                self.jit_sites.append(JitSite(
+                    call=call, fn=node, static_argnums=static_nums,
+                    static_argnames=static_names, donate_argnums=donate,
+                    bound_names=(node.name,)))
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if id(node) in decorated:
+                continue
+            call = node
+            name = dotted_name(call.func)
+            if name not in JIT_NAMES:
+                unwrapped = _unwrap_partial(call)
+                if (unwrapped is None
+                        or dotted_name(unwrapped.func) not in JIT_NAMES):
+                    continue
+                call = unwrapped
+            fn_node: Optional[ast.AST] = None
+            if call.args:
+                target = call.args[0]
+                if isinstance(target, ast.Lambda):
+                    fn_node = target
+                elif isinstance(target, ast.Name):
+                    fn_node = self.resolve_local(node, target.id)
+            static_nums: Tuple[int, ...] = ()
+            static_names: Tuple[str, ...] = ()
+            donate: Tuple[int, ...] = ()
+            for kw in call.keywords:
+                if kw.arg == "static_argnums":
+                    static_nums = _int_elements(kw.value)
+                elif kw.arg == "static_argnames":
+                    static_names = _str_elements(kw.value)
+                elif kw.arg in ("donate_argnums", "donate_argnames"):
+                    donate = _int_elements(kw.value)
+            bound_names: List[str] = []
+            bound_attrs: List[str] = []
+            parent = self.parent.get(id(node))
+            # Walk through decorator application: `f = jax.jit(g)`.
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    if isinstance(t, ast.Name):
+                        bound_names.append(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        bound_attrs.append(t.attr)
+            self.jit_sites.append(JitSite(
+                call=node if call is node else node, fn=fn_node,
+                static_argnums=static_nums, static_argnames=static_names,
+                donate_argnums=donate, bound_names=tuple(bound_names),
+                bound_attrs=tuple(bound_attrs)))
+
+    def jit_site_for_callable_name(self, name: str,
+                                   is_attr: bool) -> Optional[JitSite]:
+        """The jit site bound to ``name`` (attr name for self.X calls)."""
+        for site in self.jit_sites:
+            if is_attr and name in site.bound_attrs:
+                return site
+            if not is_attr and name in site.bound_names:
+                return site
+        return None
+
+    # -- traced contexts ------------------------------------------------
+
+    def _mark_traced(self, fn: Optional[ast.AST]) -> None:
+        if fn is None or id(fn) in self.traced_functions:
+            return
+        self.traced_functions.add(id(fn))
+        self._traced_nodes.append(fn)
+        # Nested defs run under the same trace.
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                if id(node) not in self.traced_functions:
+                    self.traced_functions.add(id(node))
+                    self._traced_nodes.append(node)
+
+    def _collect_traced(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    name = dotted_name(deco)
+                    if name is None and isinstance(deco, ast.Call):
+                        name = dotted_name(deco.func)
+                        if name not in TRACING_ENTRY_POINTS:
+                            inner = _unwrap_partial(deco)
+                            name = (dotted_name(inner.func)
+                                    if inner is not None else None)
+                    if name in TRACING_ENTRY_POINTS:
+                        self._mark_traced(node)
+            elif isinstance(node, ast.Call):
+                call = node
+                name = dotted_name(call.func)
+                if name not in TRACING_ENTRY_POINTS:
+                    unwrapped = _unwrap_partial(call)
+                    if unwrapped is None:
+                        continue
+                    call, name = unwrapped, dotted_name(unwrapped.func)
+                    if name not in TRACING_ENTRY_POINTS:
+                        continue
+                if not call.args:
+                    continue
+                target = call.args[0]
+                if isinstance(target, ast.Lambda):
+                    self._mark_traced(target)
+                elif isinstance(target, ast.Name):
+                    self._mark_traced(self.resolve_local(node, target.id))
+
+    def traced_nodes(self) -> Sequence[ast.AST]:
+        return tuple(self._traced_nodes)
+
+    def static_params_of(self, fn: ast.AST) -> Set[str]:
+        """Param names of ``fn`` that some jit site marks static."""
+        names = param_names(fn)
+        static: Set[str] = set()
+        for site in self.jit_sites:
+            if site.fn is not fn:
+                continue
+            static.update(site.static_argnames)
+            for i in site.static_argnums:
+                if 0 <= i < len(names):
+                    static.add(names[i])
+        return static

@@ -1,0 +1,184 @@
+"""GL104: read of a donated buffer after the donating call.
+
+``donate_argnums`` hands the argument's device buffer to XLA for in-place
+reuse — after the call returns, the caller's handle points at freed (or
+repurposed) memory.  jax raises on *device* access, but a numpy view or
+a zero-copy alias keeps "working" against garbage: PR 3's latent heap
+corruption was exactly this, and it surfaced hundreds of steps away from
+the bug.
+
+The rule tracks every module-local binding of a donating jit —
+``f = jax.jit(g, donate_argnums=(1,))`` and
+``self._f = jax.jit(...)`` alike — and, per function, walks statements
+in evaluation order: a plain-name argument passed at a donated position
+becomes ARMED; a later load of that name before a re-store is flagged.
+Loops are scanned twice (a donation at the bottom of iteration N is live
+at the top of iteration N+1); ``if``/``else`` branches fork the armed
+set and only survive the join when neither branch re-stored the name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from diff3d_tpu.analysis.rules.base import Rule
+from diff3d_tpu.analysis.rules.context import JitSite, ModuleContext
+
+
+class _BlockScanner:
+    def __init__(self, rule: "DonatedReuseRule", ctx: ModuleContext):
+        self.rule = rule
+        self.ctx = ctx
+        self.findings: List = []
+        self._seen: Set[Tuple[int, str]] = set()
+
+    # -- event extraction ------------------------------------------------
+
+    def _donating_site(self, call: ast.Call) -> JitSite:
+        func = call.func
+        if isinstance(func, ast.Name):
+            site = self.ctx.jit_site_for_callable_name(func.id, False)
+        elif isinstance(func, ast.Attribute):
+            site = self.ctx.jit_site_for_callable_name(func.attr, True)
+        else:
+            site = None
+        return site if site is not None and site.donate_argnums else None
+
+    def _expr_events(self, node: ast.AST):
+        """(loads, donations) of one expression, in source order."""
+        loads: List[ast.Name] = []
+        donations: List[Tuple[ast.Call, str]] = []
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                loads.append(n)
+            elif isinstance(n, ast.Call):
+                site = self._donating_site(n)
+                if site is None:
+                    continue
+                for i in site.donate_argnums:
+                    if i < len(n.args) and isinstance(n.args[i],
+                                                      ast.Name):
+                        donations.append((n, n.args[i].id))
+        loads.sort(key=lambda n: (n.lineno, n.col_offset))
+        return loads, donations
+
+    def _stores(self, node: ast.AST) -> List[str]:
+        return [n.id for n in ast.walk(node)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, (ast.Store, ast.Del))]
+
+    # -- armed-state interpreter ----------------------------------------
+
+    def _flag(self, name_node: ast.Name, donated_line: int):
+        key = (name_node.lineno, name_node.id)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(self.rule.finding(
+            self.ctx, name_node,
+            f"'{name_node.id}' was donated on line {donated_line} "
+            f"(donate_argnums) and is read here — the buffer no longer "
+            "belongs to the caller; use the returned carry instead"))
+
+    def _eval(self, node: ast.AST, armed: Dict[str, int]) -> None:
+        """Process one expression: loads fire against armed names, then
+        donations arm."""
+        # Loads are processed before this expression's donations arm, so
+        # the arming call never flags its own argument — but a name still
+        # armed from an EARLIER statement (or the previous loop pass)
+        # fires even when this expression re-donates it: passing an
+        # already-consumed buffer back into a donating call is as dead a
+        # read as any other.
+        loads, donations = self._expr_events(node)
+        for n in loads:
+            if n.id in armed:
+                self._flag(n, armed[n.id])
+                armed.pop(n.id, None)
+        for call, name in donations:
+            armed[name] = call.lineno
+
+    def scan_block(self, stmts, armed: Dict[str, int]) -> Dict[str, int]:
+        for stmt in stmts:
+            armed = self._scan_stmt(stmt, armed)
+        return armed
+
+    def _scan_stmt(self, stmt, armed: Dict[str, int]) -> Dict[str, int]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return armed        # separate scope, scanned on its own
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                             ast.Return, ast.Expr, ast.Raise,
+                             ast.Assert, ast.Delete)):
+            value = getattr(stmt, "value", None)
+            if isinstance(stmt, ast.AugAssign):
+                # load of the target happens before the store
+                if (isinstance(stmt.target, ast.Name)
+                        and stmt.target.id in armed):
+                    self._flag(stmt.target, armed[stmt.target.id])
+                    armed.pop(stmt.target.id, None)
+            if value is not None:
+                self._eval(value, armed)
+            if isinstance(stmt, ast.Assert) and stmt.test is not None:
+                self._eval(stmt.test, armed)
+            for name in self._stores(stmt):
+                armed.pop(name, None)
+            return armed
+        if isinstance(stmt, ast.If):
+            self._eval(stmt.test, armed)
+            a1 = self.scan_block(stmt.body, dict(armed))
+            a2 = self.scan_block(stmt.orelse, dict(armed))
+            # survive the join only when no branch re-stored the name
+            return {k: v for k, v in {**a1, **a2}.items()
+                    if k in a1 and k in a2 or k not in armed}
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._eval(stmt.iter, armed)
+            for name in self._stores(stmt.target):
+                armed.pop(name, None)
+            # twice: a donation at the bottom is live at the next top
+            armed = self.scan_block(stmt.body, armed)
+            armed = self.scan_block(stmt.body, armed)
+            return self.scan_block(stmt.orelse, armed)
+        if isinstance(stmt, ast.While):
+            self._eval(stmt.test, armed)
+            armed = self.scan_block(stmt.body, armed)
+            self._eval(stmt.test, armed)
+            armed = self.scan_block(stmt.body, armed)
+            return self.scan_block(stmt.orelse, armed)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval(item.context_expr, armed)
+                if item.optional_vars is not None:
+                    for name in self._stores(item.optional_vars):
+                        armed.pop(name, None)
+            return self.scan_block(stmt.body, armed)
+        if isinstance(stmt, ast.Try):
+            armed = self.scan_block(stmt.body, armed)
+            for handler in stmt.handlers:
+                armed = self.scan_block(handler.body, dict(armed))
+            armed = self.scan_block(stmt.orelse, armed)
+            return self.scan_block(stmt.finalbody, armed)
+        # fallthrough (pass, break, continue, global, import, ...)
+        value = getattr(stmt, "value", None)
+        if value is not None and isinstance(value, ast.AST):
+            self._eval(value, armed)
+        return armed
+
+
+class DonatedReuseRule(Rule):
+    id = "GL104"
+    name = "donated-buffer-reuse"
+    severity = "error"
+    description = ("a variable passed at a donate_argnums position is "
+                   "read after the donating call without reassignment")
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        if not any(site.donate_argnums for site in ctx.jit_sites):
+            return
+        scanner = _BlockScanner(self, ctx)
+        # module body + every function body, each scanned independently
+        scanner.scan_block(ctx.tree.body, {})
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scanner.scan_block(node.body, {})
+        yield from scanner.findings

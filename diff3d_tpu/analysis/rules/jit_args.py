@@ -1,0 +1,51 @@
+"""GL105: shape-like jit parameter left traced (retracing hazard).
+
+A parameter named ``shape`` / ``size`` / ``axis`` / ... that reaches a
+``jax.jit`` boundary as a *traced* argument cannot actually stay traced
+— the first use in ``jnp.zeros(shape)`` or ``x.reshape(size)``
+concretizes it, so every distinct value triggers a silent retrace.  The
+recompilation storm shows up as a perf cliff, never as an error (the
+BENCH history has the scars).  The fix is one keyword:
+``static_argnums``/``static_argnames``.
+
+The rule only fires when the wrapped function is resolvable in-module
+and the parameter's name is unambiguously shape-like — anything fuzzier
+belongs to the runtime sentinel, not the linter.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from diff3d_tpu.analysis.rules.base import Rule
+from diff3d_tpu.analysis.rules.context import ModuleContext, param_names
+
+_SHAPE_LIKE = {"shape", "shapes", "size", "sizes", "axis", "axes",
+               "ndim", "num_devices", "n_lanes"}
+
+
+class StaticShapeArgRule(Rule):
+    id = "GL105"
+    name = "missing-static-argnums"
+    severity = "warning"
+    description = ("shape-like parameter of a jitted function is not in "
+                   "static_argnums/static_argnames")
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for site in ctx.jit_sites:
+            if site.fn is None:
+                continue
+            names = param_names(site.fn)
+            static = set(site.static_argnames)
+            for i in site.static_argnums:
+                if 0 <= i < len(names):
+                    static.add(names[i])
+            for name in names:
+                if name in _SHAPE_LIKE and name not in static:
+                    yield self.finding(
+                        ctx, site.call,
+                        f"jitted function parameter '{name}' looks "
+                        "shape-like but is traced — every distinct "
+                        "value retraces; add static_argnames="
+                        f"('{name}',) (or pass it via closure)")

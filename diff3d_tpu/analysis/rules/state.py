@@ -1,0 +1,68 @@
+"""GL107: mutable state captured by (or leaking out of) traced code.
+
+Two shapes:
+
+  * a **mutable default argument** (``def f(x, cache={})``) on any
+    function — in ordinary Python it is a shared-state footgun; on a
+    function that ends up traced it is worse, because the default is
+    evaluated once and then *baked into every compiled program* that
+    closes over it;
+  * a ``global`` declaration inside a traced function — writes from a
+    traced body run once per TRACE, not once per call, so the global
+    updates exactly when a retrace happens and never again: state that
+    silently freezes after warmup.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from diff3d_tpu.analysis.rules.base import Rule
+from diff3d_tpu.analysis.rules.context import ModuleContext, dotted_name
+
+_MUTABLE_CALLS = {"list", "dict", "set", "bytearray",
+                  "collections.defaultdict", "defaultdict",
+                  "collections.OrderedDict", "OrderedDict"}
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in _MUTABLE_CALLS
+    return False
+
+
+class MutableTraceStateRule(Rule):
+    id = "GL107"
+    name = "mutable-trace-state"
+    severity = "warning"
+    description = ("mutable default argument, or `global` mutation "
+                   "inside a traced function")
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defaults = (node.args.defaults
+                            + [d for d in node.args.kw_defaults
+                               if d is not None])
+                for d in defaults:
+                    if _is_mutable_literal(d):
+                        yield self.finding(
+                            ctx, d,
+                            f"mutable default argument in "
+                            f"'{node.name}' — evaluated once and "
+                            "shared across calls (and baked into any "
+                            "trace that captures it); default to None "
+                            "and construct inside")
+            if isinstance(node, ast.Global):
+                fn = ctx.enclosing_function(node)
+                if fn is not None and id(fn) in ctx.traced_functions:
+                    yield self.finding(
+                        ctx, node,
+                        f"`global {', '.join(node.names)}` inside a "
+                        "traced function — the write runs once per "
+                        "trace, not per call; thread state through the "
+                        "carry instead", severity="error")

@@ -1,0 +1,120 @@
+"""GL106: timing device work without block_until_ready.
+
+JAX dispatch is async: ``t1 - t0`` around a jitted call measures the
+*enqueue*, not the compute — the classic way a benchmark reports a 400x
+"speedup" that is actually an unawaited future.  The rule finds pairs of
+wall-clock captures (``time.monotonic``/``perf_counter``/``time``) in
+one statement block with device work dispatched in between and no sync
+— ``block_until_ready`` / ``device_get`` / ``np.asarray`` / ``.item()``
+— anywhere in the timed span.
+
+"Device work" is deliberately narrow: calls to module-local jitted
+bindings (the jit registry) and the repo's known device entry points
+(``step_many`` / ``synthesize*`` / ``.apply``).  Timing host code with
+two clock reads is fine and stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from diff3d_tpu.analysis.rules.base import Rule
+from diff3d_tpu.analysis.rules.context import ModuleContext, dotted_name
+
+_CLOCKS = {"time.monotonic", "time.perf_counter", "time.time",
+           "monotonic", "perf_counter"}
+_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+_SYNC_CALLS = {"jax.block_until_ready", "jax.device_get", "np.asarray",
+               "np.array", "numpy.asarray", "jax.effects_barrier"}
+_DEVICE_ATTRS = {"step_many", "synthesize", "synthesize_many", "apply"}
+
+
+def _has_clock(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call)
+               and dotted_name(n.func) in _CLOCKS
+               for n in ast.walk(node))
+
+
+def _is_bare_capture(stmt: ast.AST) -> bool:
+    """``t0 = time.monotonic()`` — the *start* of a timed region (an
+    arbitrary clock-bearing statement may instead be the end of one)."""
+    return (isinstance(stmt, ast.Assign)
+            and isinstance(stmt.value, ast.Call)
+            and dotted_name(stmt.value.func) in _CLOCKS)
+
+
+def _classify_span(stmts: List[ast.AST]):
+    """(device_call, sync_found) over a span of statements, nested
+    defs included (a closure defined in the span runs inside it)."""
+    device = sync = False
+    for stmt in stmts:
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            fname = dotted_name(n.func)
+            if fname in _SYNC_CALLS:
+                sync = True
+            elif isinstance(n.func, ast.Attribute):
+                if n.func.attr in _SYNC_ATTRS:
+                    sync = True
+                elif n.func.attr in _DEVICE_ATTRS:
+                    device = True
+    return device, sync
+
+
+class UnsyncedTimingRule(Rule):
+    id = "GL106"
+    name = "unsynced-timing"
+    severity = "warning"
+    description = ("wall-clock timing around device work without "
+                   "block_until_ready — measures dispatch, not compute")
+
+    def _scan_block(self, ctx: ModuleContext, stmts: List[ast.AST],
+                    module_ctx: ModuleContext):
+        clock_idx = [i for i, s in enumerate(stmts) if _has_clock(s)]
+        starts = [i for i in clock_idx if _is_bare_capture(stmts[i])]
+        for a in starts:
+            later = [i for i in clock_idx if i > a]
+            if not later:
+                continue
+            b = later[0]
+            span = stmts[a + 1:b]
+            if not span:
+                continue
+            # jitted-binding calls inside the span count as device work
+            device, sync = _classify_span(span)
+            if not device:
+                for stmt in span:
+                    for n in ast.walk(stmt):
+                        if (isinstance(n, ast.Call)
+                                and isinstance(n.func, ast.Name)
+                                and module_ctx.jit_site_for_callable_name(
+                                    n.func.id, False) is not None):
+                            device = True
+            # the closing clock statement may carry its own sync:
+            #   dt = time.monotonic() - t0  after  out = np.asarray(r)
+            _, sync_tail = _classify_span([stmts[b]])
+            if device and not (sync or sync_tail):
+                yield self.finding(
+                    ctx, stmts[b],
+                    "wall-clock delta around device work without a "
+                    "block_until_ready/fetch in the timed span — the "
+                    "measurement stops at dispatch, not completion")
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        blocks: List[List[ast.AST]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                blocks.append(node.body)
+            elif isinstance(node, (ast.For, ast.While, ast.With, ast.If,
+                                   ast.Try)):
+                blocks.append(node.body)
+                orelse = getattr(node, "orelse", None)
+                if orelse:
+                    blocks.append(orelse)
+                finalbody = getattr(node, "finalbody", None)
+                if finalbody:
+                    blocks.append(finalbody)
+        for block in blocks:
+            yield from self._scan_block(ctx, block, ctx)

@@ -1,0 +1,143 @@
+"""GL102 + GL103: what must not happen inside a traced function.
+
+GL102 — Python ``if``/``while`` on a traced value.  Inside a jitted (or
+scan/vmap/grad) body, branching on a parameter raises
+``TracerBoolConversionError`` at trace time *if you are lucky* — and
+silently bakes one branch into the program if the value happens to be
+concrete during tracing but traced in production.  Concrete-at-trace
+tests stay silent: ``x is None``, ``isinstance(x, ...)``, and tests that
+only touch ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``len(x)`` (shapes
+are static under trace), plus parameters the jit site marks static.
+
+GL103 — host sync inside a traced body.  ``.item()`` / ``.tolist()`` /
+``float(param)`` / ``int(param)`` / ``np.asarray`` / ``np.array`` /
+``jax.device_get`` force a device->host round trip; under jit they
+either fail at trace time or, in op-by-op fallback paths, silently
+serialize the pipeline — the exact class of hidden-transfer bug the
+transfer-guard tests exist for, caught here before it runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from diff3d_tpu.analysis.rules.base import Rule
+from diff3d_tpu.analysis.rules.context import (ModuleContext, dotted_name,
+                                               param_names)
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_HOST_SYNC_ATTRS = {"item", "tolist", "to_py"}
+_HOST_SYNC_CALLS = {"jax.device_get", "np.asarray", "np.array",
+                    "numpy.asarray", "numpy.array", "onp.asarray"}
+
+
+def _concrete_name_loads(test: ast.AST) -> Set[str]:
+    """Names in ``test`` whose use is concrete at trace time (shape
+    attrs, len(), isinstance, `is None` comparisons)."""
+    concrete: Set[str] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name):
+                    concrete.add(n.id)
+        elif isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in ("len", "isinstance", "callable", "hasattr",
+                         "getattr", "type"):
+                for arg in node.args:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name):
+                            concrete.add(n.id)
+        elif isinstance(node, ast.Compare):
+            comps = [node.left] + list(node.comparators)
+            if any(isinstance(c, ast.Constant) and c.value is None
+                   for c in comps):
+                for c in comps:
+                    if isinstance(c, ast.Name):
+                        concrete.add(c.id)
+    return concrete
+
+
+def _own_statements(fn: ast.AST):
+    """Nodes of ``fn``'s body excluding nested function bodies (those
+    are traced contexts of their own and visited separately)."""
+    body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+    stack = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+class TracedBranchRule(Rule):
+    id = "GL102"
+    name = "traced-python-branch"
+    severity = "error"
+    description = ("Python if/while on a traced parameter inside a "
+                   "jit/scan/vmap body — use lax.cond/lax.select")
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for fn in ctx.traced_nodes():
+            params = set(param_names(fn)) - ctx.static_params_of(fn)
+            if not params:
+                continue
+            for node in _own_statements(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                concrete = _concrete_name_loads(node.test)
+                hot = sorted(
+                    n.id for n in ast.walk(node.test)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                    and n.id in params and n.id not in concrete)
+                if hot:
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    yield self.finding(
+                        ctx, node,
+                        f"Python `{kw}` on traced parameter(s) "
+                        f"{', '.join(hot)} inside a traced function — "
+                        "branch with lax.cond/lax.select or mark the "
+                        "argument static")
+
+
+class HostSyncRule(Rule):
+    id = "GL103"
+    name = "host-sync-in-jit"
+    severity = "error"
+    description = ("host<->device sync (.item()/float()/np.asarray/"
+                   "device_get) inside a traced body")
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for fn in ctx.traced_nodes():
+            params = set(param_names(fn)) - ctx.static_params_of(fn)
+            for node in _own_statements(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                if fname in _HOST_SYNC_CALLS:
+                    yield self.finding(
+                        ctx, node,
+                        f"{fname}() inside a traced body forces a host "
+                        "sync — keep the value on device (jnp.*) or "
+                        "move the conversion outside the jit boundary")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _HOST_SYNC_ATTRS
+                        and not node.args):
+                    yield self.finding(
+                        ctx, node,
+                        f".{node.func.attr}() inside a traced body is a "
+                        "device->host sync — return the array and "
+                        "convert outside the traced function")
+                elif (fname in ("float", "int", "bool") and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in params):
+                    yield self.finding(
+                        ctx, node,
+                        f"{fname}({node.args[0].id}) concretizes a "
+                        "traced parameter — this fails under jit; use "
+                        "astype / keep it traced")

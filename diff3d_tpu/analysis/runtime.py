@@ -1,0 +1,166 @@
+"""Runtime invariant harness: what the linter can't prove, measure.
+
+Static analysis (``analysis/lint.py``) catches the patterns visible in
+the AST; this module pins the same invariants at *run* time, replacing
+the ad-hoc copies that used to live inline in
+``tests/test_sampling_sharded.py`` and ``tests/test_serving.py``:
+
+  * :class:`RecompilationSentinel` — counts compiled-program cache
+    growth per tracked jitted callable and asserts a budget.  A retrace
+    is invisible (jax just... compiles again); under a compile budget it
+    is a hard failure with the per-callable counts in the message.
+  * :func:`no_host_transfers` — scoped ``jax.transfer_guard("disallow")``:
+    any implicit host<->device transfer inside the block faults.
+  * :func:`assert_consumed` / :func:`assert_live` — donation guards: a
+    donated input buffer must actually be deleted (the update happened
+    in place), the returned carry must not be.
+  * :func:`owned` — copy a host array into an XLA-owned device buffer
+    before handing it to a donating program.  ``jnp.asarray`` may
+    zero-copy alias aligned numpy memory (CPU backend); donating such an
+    alias frees memory the XLA allocator does not own — the PR 3 heap
+    corruption.  This is the same contract ``Sampler._owned`` enforces
+    for the public step API, exported so tests and tools build donated
+    operands one way.
+
+The pytest side (``analysis/pytest_plugin.py``) exposes the sentinel as
+the ``compile_sentinel`` fixture and enforces
+``@pytest.mark.compile_budget(n)`` at teardown.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class CompileBudgetExceeded(AssertionError):
+    """A tracked callable compiled more programs than its budget."""
+
+
+class RecompilationSentinel:
+    """Per-callable compiled-program counter with budget assertions.
+
+    Tracked callables must expose the jitted-function cache probe
+    (``_cache_size``), which counts distinct compiled programs — it is
+    immune to the persistent on-disk compilation cache (a disk hit still
+    mints a new in-memory program entry), so budgets hold regardless of
+    cache warmth.
+
+        sentinel = RecompilationSentinel()
+        sentinel.track("view_step", sampler._run_view_many)
+        ... run workload ...
+        sentinel.assert_budget(1)     # one program, ever
+
+    ``track`` records the callable's CURRENT cache size as the zero
+    point, so tracking an already-warm function counts only growth.
+    """
+
+    def __init__(self):
+        self._fns: Dict[str, object] = {}
+        self._base: Dict[str, int] = {}
+
+    @staticmethod
+    def _cache_size(fn) -> int:
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            raise TypeError(
+                f"{fn!r} has no _cache_size probe — track jitted "
+                "callables (jax.jit/pjit results), not plain functions")
+        return int(probe())
+
+    def track(self, name: str, fn):
+        """Start counting ``fn``'s compiles under ``name``; returns
+        ``fn`` so call sites can track inline."""
+        self._base[name] = self._cache_size(fn)
+        self._fns[name] = fn
+        return fn
+
+    def counts(self) -> Dict[str, int]:
+        """Programs compiled per tracked callable since ``track``."""
+        return {name: self._cache_size(fn) - self._base[name]
+                for name, fn in self._fns.items()}
+
+    def total(self) -> int:
+        return sum(self.counts().values())
+
+    def reset(self) -> None:
+        for name, fn in self._fns.items():
+            self._base[name] = self._cache_size(fn)
+
+    def assert_budget(self, budget: int,
+                      name: Optional[str] = None) -> None:
+        """Fail if compiles exceed ``budget`` (for one callable, or the
+        total across all tracked callables when ``name`` is None)."""
+        counts = self.counts()
+        spent = counts[name] if name is not None else sum(counts.values())
+        if spent > budget:
+            raise CompileBudgetExceeded(
+                f"compile budget exceeded: {spent} > {budget} "
+                f"({'callable ' + name if name else 'total'}; "
+                f"per-callable: {counts}) — an input shape/dtype or a "
+                "Python-level closure changed between calls")
+
+
+@contextlib.contextmanager
+def compile_budget(budget: int, **fns):
+    """Scoped budget over named jitted callables::
+
+        with compile_budget(1, view_step=sampler._run_view_many):
+            sampler.synthesize_many(...)
+    """
+    sentinel = RecompilationSentinel()
+    for name, fn in fns.items():
+        sentinel.track(name, fn)
+    yield sentinel
+    sentinel.assert_budget(budget)
+
+
+@contextlib.contextmanager
+def no_host_transfers():
+    """Fault on any implicit host<->device transfer inside the block.
+
+    Wraps ``jax.transfer_guard("disallow")``: device-resident code runs
+    clean, anything that silently re-stages host memory (or fetches to
+    host) raises at the transfer point.  Stage all operands on device
+    *before* entering the block; explicit ``jax.device_put`` inside it
+    faults too — that is the point."""
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+def assert_consumed(*buffers) -> None:
+    """Donation guard: every buffer must have been deleted by a donating
+    call — i.e. the program reused its memory in place.  A live buffer
+    here means donation silently degraded to a copy (wrong in_shardings,
+    a captured reference, or a backend that refused the alias)."""
+    for i, buf in enumerate(buffers):
+        if not buf.is_deleted():
+            raise AssertionError(
+                f"donation guard: buffer {i} is still live after a "
+                "donating call — the in-place update degraded to a "
+                "copy (check donate_argnums and sharding specs)")
+
+
+def assert_live(*buffers) -> None:
+    """The returned carry of a donating call must NOT be deleted."""
+    for i, buf in enumerate(buffers):
+        if buf.is_deleted():
+            raise AssertionError(
+                f"donation guard: returned carry {i} is deleted — the "
+                "caller is holding a donated input instead of the "
+                "returned buffer")
+
+
+def owned(x) -> jax.Array:
+    """Copy ``x`` into an XLA-owned device buffer safe to donate.
+
+    Device arrays pass through untouched (already XLA-owned); host
+    arrays are uploaded and copied so no zero-copy alias of caller
+    memory can be donated.
+    """
+    if isinstance(x, jax.Array):
+        return x
+    return jnp.copy(jnp.asarray(x))

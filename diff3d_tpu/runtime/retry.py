@@ -194,12 +194,22 @@ def acquire_backend(attempts: int = 6, wait_s: float = 75.0, *,
             signal.signal(signal.SIGALRM, prev)
 
     def _reset_and_notify(attempt: int, exc: BaseException, delay: float):
-        # Drop the poisoned client so the next jax.devices() re-dials the
-        # backend instead of returning the cached failure (private API;
-        # guarded so an API move degrades to plain retry).
+        # Drop the poisoned registry state so the next jax.devices()
+        # re-dials the backend instead of returning the cached failure
+        # (private API; guarded so an API move degrades to plain retry).
+        # ONLY when no client was ever constructed: a cached *failed*
+        # initialization is the one state a clear helps with, and the one
+        # state it is safe in.  Tearing down a live client is a native
+        # use-after-free — buffers, compiled-executable caches and
+        # jax-internal globals keep raw references to it, and the freed
+        # heap chunks get rewritten by the next dial (observed as
+        # ``cpu_client.cc CHECK`` failures / malloc aborts in whatever
+        # large computation runs next).  If a client exists, the dial
+        # error was transient and plain retry suffices.
         try:
             from jax._src import xla_bridge
-            xla_bridge._clear_backends()
+            if not xla_bridge._backends:
+                xla_bridge._clear_backends()
         except Exception:  # pragma: no cover - best effort
             pass
         if on_retry is not None:

@@ -21,8 +21,8 @@ Fault kinds:
   classification as real transient faults).
 * ``"slow"``    — sleep ``delay_s`` before proceeding (drives watchdog
   stuck-step detection).
-* ``"sigterm"`` — deliver a real ``SIGTERM`` to this process (drives
-  the trainer's preemption path end-to-end).
+* ``"sigterm"`` — deliver a real ``SIGTERM`` to this process's main
+  thread (drives the trainer's preemption path end-to-end).
 """
 
 from __future__ import annotations
@@ -138,7 +138,16 @@ class FaultInjector:
                 time.sleep(spec.delay_s)
             elif spec.kind == "sigterm":
                 log.info("fault[%s]: delivering SIGTERM (call %d)", site, n)
-                os.kill(os.getpid(), signal.SIGTERM)
+                # Target the main thread explicitly.  os.kill() lets the
+                # kernel pick any thread that doesn't block SIGTERM —
+                # including runtime worker threads (XLA dispatch,
+                # TensorStore I/O), and interrupting one of those
+                # mid-operation can abort the whole process instead of
+                # driving the Python-level handler.  pthread_kill still
+                # exercises the real installed handler; it only makes the
+                # delivery point deterministic.
+                signal.pthread_kill(
+                    threading.main_thread().ident, signal.SIGTERM)
             else:
                 exc = (spec.exc() if spec.exc is not None
                        else FaultInjected(f"injected fault at {site} (call {n})"))

@@ -10,11 +10,14 @@ Three contracts pinned here, on the 8-virtual-device CPU mesh (conftest):
     view-step executable (the autoregressive loop re-enters the same
     jitted function with identical shapes; any per-view recompile is a
     bug that would multiply sampling cost by the compile time).
+    Enforced by the ``compile_sentinel`` fixture and the
+    ``@pytest.mark.compile_budget`` marker from
+    ``diff3d_tpu.analysis.pytest_plugin``.
   * DEVICE RESIDENCE — after the first view step, the record carry never
     crosses the host boundary: a second step under
-    ``jax.transfer_guard("disallow")`` runs clean, and the donated input
-    buffer is actually consumed (``is_deleted``), i.e. the update is in
-    place rather than a device-side copy.
+    ``analysis.runtime.no_host_transfers()`` runs clean, and the donated
+    input buffer is actually consumed (``assert_consumed``), i.e. the
+    update is in place rather than a device-side copy.
 
 Plus the serving-side divisibility rules (``lane_count`` rounding and the
 engine's mesh-quantised ``max_batch``) and an end-to-end sharded engine
@@ -28,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from diff3d_tpu.analysis.runtime import (assert_consumed, assert_live,
+                                         no_host_transfers, owned)
 from diff3d_tpu.config import MeshConfig, ServingConfig
 from diff3d_tpu.config import test_config as make_tiny_config
 from diff3d_tpu.data import SyntheticDataset
@@ -130,19 +135,23 @@ def test_step_many_rejects_non_multiple_batch(setup):
 # ---------------------------------------------------------------------------
 
 
-def test_synthesize_many_compiles_exactly_once(setup):
+@pytest.mark.compile_budget(1)
+def test_synthesize_many_compiles_exactly_once(setup, compile_sentinel):
     """The whole autoregressive run (3 view steps here) re-enters ONE
     compiled executable — record_len is a traced argument, not a shape,
-    so no view index triggers its own program."""
+    so no view index triggers its own program.  The marker enforces the
+    budget at teardown; the inline check pins that exactly one program
+    exists (not zero) and that the second run re-enters it."""
     cfg, model, params, ds = setup
     sampler = Sampler(model, params, cfg, mesh=_mesh(2))
+    compile_sentinel.track("view_step", sampler._run_view_many)
     views = [ds.all_views(0), ds.all_views(1)]
     keys = [jax.random.PRNGKey(0), jax.random.PRNGKey(1)]
     sampler.synthesize_many(views, keys, max_views=4)
-    assert sampler._run_view_many._cache_size() == 1
+    assert compile_sentinel.counts()["view_step"] == 1
     # A second run with the same shapes stays on the same program.
     sampler.synthesize_many(views, keys, max_views=4)
-    assert sampler._run_view_many._cache_size() == 1
+    assert compile_sentinel.counts()["view_step"] == 1
 
 
 # ---------------------------------------------------------------------------
@@ -155,11 +164,11 @@ def _device_record(sampler, views, cfg, n_views):
     rec_i, rec_R, rec_T = sampler._record_init(
         imgs[0], np.asarray(views["R"], np.float32),
         np.asarray(views["T"], np.float32), n_views)
-    # jnp.copy, not bare jnp.asarray: the record carry is DONATED, and
+    # owned(), not bare jnp.asarray: the record carry is DONATED, and
     # asarray may zero-copy alias the numpy buffer — donating an aliased
     # buffer leaves the carry pointing at freed host memory (the same
     # contract Sampler._owned enforces for the public step API).
-    return (jnp.copy(jnp.asarray(rec_i)), jnp.asarray(rec_R),
+    return (owned(rec_i), jnp.asarray(rec_R),
             jnp.asarray(rec_T),
             jnp.asarray(np.asarray(views["K"], np.float32)))
 
@@ -167,8 +176,8 @@ def _device_record(sampler, views, cfg, n_views):
 def test_step_loop_runs_under_transfer_guard(setup):
     """Steady-state view steps move NOTHING across the host boundary:
     after one warmup step, further steps on the returned carry run under
-    ``jax.transfer_guard('disallow')`` (which faults on any implicit
-    host->device or device->host transfer)."""
+    ``no_host_transfers()`` (scoped transfer_guard: faults on any
+    implicit host->device or device->host transfer)."""
     cfg, model, params, ds = setup
     sampler = Sampler(model, params, cfg)
     rec_i, rec_R, rec_T, K = _device_record(sampler, ds.all_views(0), cfg,
@@ -178,7 +187,7 @@ def test_step_loop_runs_under_transfer_guard(setup):
     # Warmup: compiles the program and commits every operand to device.
     out, rec_i, step, rng = sampler.step(rec_i, rec_R, rec_T, step, K, rng)
     jax.block_until_ready(out)
-    with jax.transfer_guard("disallow"):
+    with no_host_transfers():
         out, rec_i, step, rng = sampler.step(rec_i, rec_R, rec_T, step, K,
                                              rng)
         out2, rec_i, step, rng = sampler.step(rec_i, rec_R, rec_T, step,
@@ -198,8 +207,8 @@ def test_step_donates_record_buffer(setup):
                                     jnp.asarray(1, jnp.int32), K,
                                     jnp.asarray(jax.random.PRNGKey(0)))
     jax.block_until_ready(new_rec)
-    assert rec_i.is_deleted()
-    assert not new_rec.is_deleted()
+    assert_consumed(rec_i)
+    assert_live(new_rec)
 
 
 def test_step_loop_bitwise_matches_synthesize(setup):

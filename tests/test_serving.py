@@ -374,13 +374,17 @@ def test_http_poll_path(setup_service):
                                   direct)
 
 
-def test_hot_params_swap_changes_output_without_recompile(setup_service):
+@pytest.mark.compile_budget(0)
+def test_hot_params_swap_changes_output_without_recompile(setup_service,
+                                                          compile_sentinel):
     cfg, model, params, sampler, service, ds = setup_service
     port = service.port
     p = _payload(ds, 3, seed=13)
     _, base = _post(port, p)
-    compiles_before = service.metrics_snapshot()["counters"][
-        "serving_program_compiles_total"]
+    # Zero-compile budget from here on: the first request above compiled
+    # the view-step program; a params swap must re-enter it (params is a
+    # jit *argument*, never baked into the executable).
+    compile_sentinel.track("view_step", sampler._run_view_many)
 
     # A different random init is NOT enough here: the X-UNet's output
     # conv is zero-initialised, so any fresh init predicts eps=0 and the
@@ -398,10 +402,8 @@ def test_hot_params_swap_changes_output_without_recompile(setup_service):
                                   np.asarray(swapped["views"]))
     finally:
         service.registry.swap(params, version="v0")
-    compiles_after = service.metrics_snapshot()["counters"][
-        "serving_program_compiles_total"]
-    assert compiles_after == compiles_before, \
-        "hot swap must not recompile (params is a jit argument)"
+    # The compile_budget(0) marker fails the test at teardown if the
+    # swap minted a new executable.
 
 
 def test_queue_full_and_degraded_health_over_http(setup_service):

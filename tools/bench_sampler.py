@@ -8,10 +8,13 @@ one compiled ``lax.scan``.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 
 def main() -> None:
@@ -57,6 +60,7 @@ def main() -> None:
 
     t0 = time.perf_counter()
     sampler.synthesize(views, rng, max_views=n_views)
+    # graftlint: disable-next-line=GL106(synthesize fetches the record to host before returning - value-synced)
     dt = time.perf_counter() - t0
     per_view = dt / (n_views - 1)
     print(f"sampler: {per_view:.2f}s/view "

@@ -19,10 +19,13 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 # (global_batch, accum) per config — the shapes bench.py measures.
 BENCH_SHAPE = {"srn64": (128, 2), "srn128": (16, 4)}

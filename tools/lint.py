@@ -1,0 +1,24 @@
+"""Repo lint gate, runnable as a plain script: ``python tools/lint.py``.
+
+Thin wrapper over ``python -m diff3d_tpu.analysis`` (graftlint) so the
+gate works from a checkout without installing the package.  All
+arguments pass through — see ``--help`` for the rule catalog and
+baseline workflow, and docs/DESIGN.md §9 for policy.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from diff3d_tpu.analysis.lint import main as lint_main
+    return lint_main(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

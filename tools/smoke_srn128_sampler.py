@@ -10,10 +10,13 @@ Usage: python tools/smoke_srn128_sampler.py [--full_width] [--views 3]
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 
 def main() -> None:
@@ -67,12 +70,14 @@ def main() -> None:
     n = args.views + 1
     t0 = time.time()
     out = sampler.synthesize(views, jax.random.PRNGKey(1), max_views=n)
+    # graftlint: disable-next-line=GL106(synthesize fetches the record to host before returning - value-synced)
     t_first = time.time() - t0
     print(f"{args.views} views (incl. compile): {t_first:.1f}s  "
           f"out {out.shape}")
 
     t0 = time.time()
     out = sampler.synthesize(views, jax.random.PRNGKey(2), max_views=n)
+    # graftlint: disable-next-line=GL106(synthesize fetches the record to host before returning - value-synced)
     dt = time.time() - t0
     print(f"steady: {args.views} views in {dt:.1f}s -> "
           f"{dt / args.views:.2f} s/view")

@@ -10,10 +10,13 @@ With no args, runs a standard sweep at the srn64 config.
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 CONFIG = "srn64"
 
