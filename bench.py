@@ -142,6 +142,23 @@ def _run(global_batch: int, n_steps: int, accum: int = 1,
         "steps_per_window": n_steps,
         "retried": retried,
     }
+    # shardcheck comms report of the program just timed, so perf numbers
+    # and collective counts travel in one JSON record (docs/DESIGN.md
+    # §10).  Lowered on ABSTRACT args via the sharded step's .lower hook
+    # (no extra buffers); best-effort — a report failure must never void
+    # the headline metric.
+    try:
+        from diff3d_tpu.analysis import ir as ir_lib
+
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            (state, batch))
+        report = ir_lib.analyze_lowered(
+            f"train_step_{config}",
+            step_fn.lower(abstract[0], abstract[1], rng))
+        stats["comms"] = ir_lib.comms_summary(report)
+    except Exception as e:
+        stats["comms"] = {"error": str(e).splitlines()[0][:200]}
     return median, stats
 
 
@@ -188,7 +205,8 @@ def _train_bench(configs, n_steps: int, config: str):
 def _sampler_bench(config: str = "srn64", n_views: int = 4,
                    object_batch: int = 1, use_mesh: bool = False,
                    sampler_kind: str = "ancestral",
-                   steps: int | None = None):
+                   steps: int | None = None,
+                   comms_out: dict | None = None):
     """Seconds per synthesised view, reference sampler config (256 steps,
     8-weight guidance sweep, ``/root/reference/sampling.py:130-158``) —
     one compiled lax.scan per view.  ``srn128`` runs the full-resolution
@@ -209,6 +227,13 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     schedule subset (``diffusion/core.py``): the default is the
     reference protocol above; ``("ddim", 16)`` times the few-step
     deterministic path the serving layer exposes.
+
+    ``comms_out``, when given a dict, is filled with the shardcheck
+    comms summary of the batched view-step program (collective counts /
+    bytes / upcasts — ``analysis/ir.py``), so the recorded JSON carries
+    comms next to the perf number.  Best-effort: on failure (e.g. the
+    chunked srn128 path has no single program to lower) the dict gets
+    an ``error`` note instead.
     """
     import jax
     import numpy as np
@@ -232,6 +257,19 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     sampler = Sampler(model, init_params(model, cfg, rng), cfg,
                       scan_chunks=chunks, mesh=mesh_env,
                       sampler_kind=sampler_kind, steps=steps)
+
+    if comms_out is not None:
+        try:
+            from diff3d_tpu.analysis import ir as ir_lib
+            from diff3d_tpu.sampling.runtime import record_capacity
+
+            lanes = max(object_batch, sampler.lane_multiple)
+            lowered = sampler.lower_step_many(
+                lanes, record_capacity(n_views))
+            comms_out.update(ir_lib.comms_summary(ir_lib.analyze_lowered(
+                f"step_many_{config}", lowered)))
+        except Exception as e:
+            comms_out["error"] = str(e).splitlines()[0][:200]
 
     s = cfg.model.H
 
@@ -450,7 +488,8 @@ def main() -> int:
         except Exception as e:
             payload["srn128"] = {"error": str(e).splitlines()[0][:200]}
         try:
-            sec_per_view, raw_s, n_eff = _sampler_bench()
+            comms: dict = {}
+            sec_per_view, raw_s, n_eff = _sampler_bench(comms_out=comms)
             payload["sampler"] = {
                 "metric": f"sampler_sec_per_view_srn64_{platform}",
                 "value": round(sec_per_view, 2),
@@ -459,6 +498,7 @@ def main() -> int:
                 "raw_seconds": round(raw_s, 2),
                 "effective_views": n_eff,
                 "chips_used": 1,
+                "comms": comms,
             }
         except Exception as e:
             payload["sampler"] = {"error": str(e).splitlines()[0][:200]}
@@ -468,8 +508,10 @@ def main() -> int:
             # unsharded block above keeps its longitudinal metric name;
             # per-chip scaling = value / sharded.sec_per_view.
             try:
+                sh_comms: dict = {}
                 sh_spv, sh_raw, sh_eff = _sampler_bench(
-                    object_batch=ndev, use_mesh=True)
+                    object_batch=ndev, use_mesh=True,
+                    comms_out=sh_comms)
                 payload["sampler"]["sharded"] = {
                     "chips_used": ndev,
                     "sec_per_view": round(sh_spv, 2),
@@ -479,6 +521,7 @@ def main() -> int:
                     "speedup_vs_single": round(
                         payload["sampler"]["value"] / sh_spv, 2)
                     if sh_spv else None,
+                    "comms": sh_comms,
                 }
             except Exception as e:
                 payload["sampler"]["sharded"] = {

@@ -1,16 +1,25 @@
 """Static analysis + runtime invariants for the TPU hot paths.
 
-Two halves, one contract (DESIGN.md §9):
+Three layers, one contract (DESIGN.md §9–10):
 
   * ``analysis.lint`` — graftlint, the AST tracer-hygiene linter
     (``python -m diff3d_tpu.analysis`` walks diff3d_tpu/, tools/ and
     bench.py and exits nonzero on unsuppressed findings; tier 1 runs it
     as a gate);
+  * ``analysis.ir`` / ``analysis.budgets`` / ``analysis.shardcheck`` —
+    the IR-level sharding & communication analyzer: per-program
+    collective/dtype/param-placement reports over lowered StableHLO and
+    compiled HLO, diffed against committed budget manifests under
+    ``runs/shardcheck/`` (``shardcheck`` console script; tools/lint.py
+    runs both passes as one gate);
   * ``analysis.runtime`` — the recompilation sentinel, transfer/donation
-    guards and the ``compile_budget`` pytest marker that enforce the
-    same invariants on running code.
+    guards and the ``compile_budget``/``comms_budget`` pytest markers
+    that enforce the same invariants on running code.
 """
 
+from diff3d_tpu.analysis.ir import (ProgramReport, analyze_jitted,
+                                    analyze_lowered, comms_summary,
+                                    cost_summary)
 from diff3d_tpu.analysis.lint import (Finding, lint_paths, lint_source,
                                       main)
 from diff3d_tpu.analysis.runtime import (CompileBudgetExceeded,
@@ -21,6 +30,8 @@ from diff3d_tpu.analysis.runtime import (CompileBudgetExceeded,
 
 __all__ = [
     "Finding", "lint_paths", "lint_source", "main",
+    "ProgramReport", "analyze_lowered", "analyze_jitted",
+    "comms_summary", "cost_summary",
     "RecompilationSentinel", "CompileBudgetExceeded", "compile_budget",
     "no_host_transfers", "assert_consumed", "assert_live", "owned",
 ]

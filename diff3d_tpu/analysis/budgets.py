@@ -1,0 +1,286 @@
+"""Per-program comms budget manifests for shardcheck.
+
+A **manifest** pins one pjit program's communication/dtype footprint:
+how many of each collective (and how many bytes), how many explicit
+resharding sites, which dtype upcasts, how many host callbacks, and
+whether the param-sharding policy must hold.  Manifests are JSON files
+committed under ``runs/shardcheck/`` — one per registered program — so
+a PR that makes the train step start all-gathering its fsdp params
+shows up as a *diff against a committed file*, reviewable like any
+other regression.
+
+Checking a :class:`~diff3d_tpu.analysis.ir.ProgramReport` against its
+manifest yields graftlint-compatible :class:`Finding`s (rules SC2xx,
+fingerprinted via ``fingerprint_data`` so they share the baseline
+format).  Suppressions follow the same reason-mandatory discipline as
+graftlint's inline comments, but live in the manifest itself::
+
+    "suppressions": [
+      {"rule": "SC204", "key": "bf16->f32",
+       "reason": "loss accumulates in f32 by design"}
+    ]
+
+``key`` scopes the suppression to one subject (a collective op, an
+upcast pair, a param path); ``"*"`` covers the whole rule.  A
+suppression without a reason is itself reported (SC002, mirroring
+graftlint's GL002).
+
+Rules:
+
+  SC002  manifest suppression without a reason        (warning)
+  SC201  fsdp/tp-policy param lowered fully replicated (error)
+  SC202  collective instruction count over budget      (error)
+  SC203  collective bytes over budget                  (error)
+  SC204  dtype upcast not in budget / over count       (error)
+  SC205  host callback not in budget                   (error)
+  SC206  resharding sites over budget                  (error)
+  SC207  program has no committed manifest             (error)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from diff3d_tpu.analysis.ir import ProgramReport
+from diff3d_tpu.analysis.lint import (Finding, SEVERITY_ERROR,
+                                      SEVERITY_WARNING)
+
+#: Default manifest directory, relative to the repo root.
+DEFAULT_MANIFEST_DIR = os.path.join("runs", "shardcheck")
+
+MANIFEST_VERSION = 1
+MANIFEST_TOOL = "shardcheck"
+
+
+@dataclasses.dataclass
+class Suppression:
+    rule: str
+    key: str = "*"
+    reason: Optional[str] = None
+
+    def covers(self, rule: str, key: str) -> bool:
+        return self.rule == rule and self.key in ("*", key)
+
+
+@dataclasses.dataclass
+class Budget:
+    """The limits a manifest imposes.  ``collectives`` maps opcode to
+    ``{"count": n, "bytes": n}`` ceilings; ``dtype_upcasts`` maps
+    ``"src->dst"`` to a count ceiling (absent pair = forbidden);
+    ``host_callbacks`` is a list of *allowed* custom-call targets."""
+
+    collectives: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    resharding_sites: int = 0
+    dtype_upcasts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    host_callbacks: List[str] = dataclasses.field(default_factory=list)
+    require_param_policy: bool = True
+
+
+@dataclasses.dataclass
+class Manifest:
+    program: str
+    mesh: Dict[str, int]
+    budgets: Budget
+    observed: dict = dataclasses.field(default_factory=dict)
+    suppressions: List[Suppression] = dataclasses.field(
+        default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "version": MANIFEST_VERSION,
+            "tool": MANIFEST_TOOL,
+            "program": self.program,
+            "mesh": dict(self.mesh),
+            "budgets": dataclasses.asdict(self.budgets),
+            "observed": self.observed,
+            "suppressions": [dataclasses.asdict(s)
+                             for s in self.suppressions],
+        }
+
+
+def manifest_path(program: str, manifest_dir: str) -> str:
+    return os.path.join(manifest_dir, f"{program}.json")
+
+
+def load_manifest(path: str) -> Manifest:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if (not isinstance(data, dict)
+            or data.get("version") != MANIFEST_VERSION
+            or data.get("tool") != MANIFEST_TOOL):
+        raise ValueError(f"{path}: not a shardcheck manifest "
+                         f"(version {MANIFEST_VERSION})")
+    b = data.get("budgets", {})
+    budgets = Budget(
+        collectives={str(k): {"count": int(v.get("count", 0)),
+                              "bytes": int(v.get("bytes", 0))}
+                     for k, v in b.get("collectives", {}).items()},
+        resharding_sites=int(b.get("resharding_sites", 0)),
+        dtype_upcasts={str(k): int(v)
+                       for k, v in b.get("dtype_upcasts", {}).items()},
+        host_callbacks=[str(x) for x in b.get("host_callbacks", [])],
+        require_param_policy=bool(b.get("require_param_policy", True)))
+    supps = [Suppression(rule=str(s.get("rule", "")),
+                         key=str(s.get("key", "*")),
+                         reason=s.get("reason"))
+             for s in data.get("suppressions", [])]
+    return Manifest(program=str(data.get("program", "")),
+                    mesh={str(k): int(v)
+                          for k, v in data.get("mesh", {}).items()},
+                    budgets=budgets,
+                    observed=data.get("observed", {}),
+                    suppressions=supps)
+
+
+def write_manifest(path: str, manifest: Manifest) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(manifest.to_json(), f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def manifest_from_report(report: ProgramReport,
+                         suppressions: Optional[
+                             Sequence[Suppression]] = None) -> Manifest:
+    """Pin a report as the budget: observed counts become the ceilings.
+
+    Lowering is deterministic for fixed shapes/mesh, so exact pins are
+    the right default — any drift is a diff a human reviews (and either
+    accepts by re-pinning with ``--update`` or fixes).
+    """
+    budgets = Budget(
+        collectives={op: c.to_json()
+                     for op, c in sorted(report.collectives.items())},
+        resharding_sites=len(report.resharding_sites),
+        dtype_upcasts=dict(sorted(report.dtype_upcasts.items())),
+        host_callbacks=list(report.host_callbacks),
+        require_param_policy=True)
+    return Manifest(program=report.name, mesh=dict(report.mesh_shape),
+                    budgets=budgets, observed=report.to_json(),
+                    suppressions=list(suppressions or []))
+
+
+# -- checking ----------------------------------------------------------
+
+
+def _finding(manifest_file: str, rule: str, program: str, key: str,
+             message: str, severity: str = SEVERITY_ERROR) -> Finding:
+    return Finding(
+        path=manifest_file, rule=rule, line=1, col=0, severity=severity,
+        message=f"[{program}] {message}",
+        fingerprint_data=f"{program}\x00{rule}\x00{key}")
+
+
+def check_report(report: ProgramReport, manifest: Manifest,
+                 manifest_file: str) -> List[Finding]:
+    """Diff a program report against its manifest.  Returns ALL findings
+    (suppressed ones marked), same contract as ``lint_source``."""
+    raw: List[Finding] = []
+    b = manifest.budgets
+    prog = report.name
+
+    if b.require_param_policy:
+        for path in report.replicated_policy_params:
+            raw.append(_finding(
+                manifest_file, "SC201", prog, path,
+                f"param {path} lowered fully replicated but the mesh "
+                f"policy shards it — silent replication (check "
+                f"param_sharding thresholds / divisibility)"))
+
+    for op, stat in sorted(report.collectives.items()):
+        limit = b.collectives.get(op)
+        if limit is None:
+            raw.append(_finding(
+                manifest_file, "SC202", prog, op,
+                f"unbudgeted collective {op}: {stat.count} instruction(s)"
+                f", {stat.bytes} bytes (manifest has no entry)"))
+            continue
+        if stat.count > limit["count"]:
+            raw.append(_finding(
+                manifest_file, "SC202", prog, op,
+                f"{op} count {stat.count} exceeds budget "
+                f"{limit['count']}"))
+        if stat.bytes > limit["bytes"]:
+            raw.append(_finding(
+                manifest_file, "SC203", prog, op,
+                f"{op} bytes {stat.bytes} exceed budget "
+                f"{limit['bytes']}"))
+
+    for pair, count in sorted(report.dtype_upcasts.items()):
+        limit = b.dtype_upcasts.get(pair)
+        if limit is None:
+            raw.append(_finding(
+                manifest_file, "SC204", prog, pair,
+                f"unbudgeted dtype upcast {pair}: {count} site(s)"))
+        elif count > limit:
+            raw.append(_finding(
+                manifest_file, "SC204", prog, pair,
+                f"dtype upcast {pair}: {count} site(s) exceed budget "
+                f"{limit}"))
+
+    for target in report.host_callbacks:
+        if target not in b.host_callbacks:
+            raw.append(_finding(
+                manifest_file, "SC205", prog, target,
+                f"host callback {target} in traced body is not in the "
+                f"manifest's allowed list"))
+
+    n_sites = len(report.resharding_sites)
+    if n_sites > b.resharding_sites:
+        raw.append(_finding(
+            manifest_file, "SC206", prog, "resharding_sites",
+            f"{n_sites} resharding site(s) exceed budget "
+            f"{b.resharding_sites}"))
+
+    return _apply_suppressions(raw, manifest, manifest_file, prog)
+
+
+def _apply_suppressions(raw: Sequence[Finding], manifest: Manifest,
+                        manifest_file: str, prog: str) -> List[Finding]:
+    out: List[Finding] = []
+    for f in raw:
+        key = (f.fingerprint_data or "").split("\x00")[-1]
+        supp = next((s for s in manifest.suppressions
+                     if s.covers(f.rule, key)), None)
+        if supp is not None:
+            f = dataclasses.replace(f, suppressed=True,
+                                    suppress_reason=supp.reason)
+        out.append(f)
+    # Reason-mandatory, like graftlint inline suppressions (GL002).
+    for s in manifest.suppressions:
+        if not s.reason:
+            out.append(_finding(
+                manifest_file, "SC002", prog, f"{s.rule}:{s.key}",
+                f"manifest suppression of {s.rule} (key={s.key!r}) has "
+                f"no reason — every suppression documents why it is "
+                f"safe", severity=SEVERITY_WARNING))
+    return out
+
+
+def missing_manifest_finding(program: str,
+                             manifest_dir: str) -> Finding:
+    path = manifest_path(program, manifest_dir)
+    return _finding(
+        path, "SC207", program, "missing",
+        f"no committed manifest at {path} — run "
+        f"'shardcheck --update --program {program}' and commit the "
+        f"result")
+
+
+def check_report_against_dir(report: ProgramReport,
+                             manifest_dir: str) -> List[Finding]:
+    """Load ``<dir>/<program>.json`` and check; a missing or unreadable
+    manifest is itself a finding (SC207)."""
+    path = manifest_path(report.name, manifest_dir)
+    if not os.path.exists(path):
+        return [missing_manifest_finding(report.name, manifest_dir)]
+    try:
+        manifest = load_manifest(path)
+    except (ValueError, json.JSONDecodeError) as e:
+        return [_finding(path, "SC207", report.name, "unreadable",
+                         f"manifest unreadable: {e}")]
+    return check_report(report, manifest, path)

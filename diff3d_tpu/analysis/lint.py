@@ -99,19 +99,27 @@ class Finding:
     message: str
     suppressed: bool = False
     suppress_reason: Optional[str] = None
+    #: IR-level findings (shardcheck SC2xx) have no source line to hash;
+    #: they set this to a stable key (program + rule + subject) instead,
+    #: so AST and IR findings share one fingerprint-baseline format.
+    fingerprint_data: Optional[str] = None
 
     def fingerprint(self, root: str) -> str:
         """Location-independent identity for baseline matching: file +
         rule + the violating source line's text (so pure line-number
-        drift does not invalidate a baseline entry)."""
+        drift does not invalidate a baseline entry).  IR findings hash
+        their ``fingerprint_data`` key instead of a source line."""
         rel = os.path.relpath(self.path, root)
-        try:
-            with open(self.path, encoding="utf-8") as f:
-                lines = f.read().splitlines()
-            text = lines[self.line - 1].strip() if self.line <= len(
-                lines) else ""
-        except OSError:
-            text = ""
+        if self.fingerprint_data is not None:
+            text = self.fingerprint_data
+        else:
+            try:
+                with open(self.path, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+                text = lines[self.line - 1].strip() if self.line <= len(
+                    lines) else ""
+            except OSError:
+                text = ""
         h = hashlib.sha256(
             f"{rel}\x00{self.rule}\x00{text}".encode()).hexdigest()
         return h[:20]

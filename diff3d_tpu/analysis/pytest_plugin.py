@@ -13,13 +13,75 @@ package — pytest resolves the module off ``sys.path``).  Exposes:
     callables would vacuously pass.
   * ``compile_sentinel`` — a fresh :class:`RecompilationSentinel` per
     test, usable with or without the marker.
+  * ``@pytest.mark.comms_budget(...)`` — the test's analyzed programs
+    (lowered pjit programs registered with the ``comms_check`` fixture)
+    may not exceed the given collective/resharding/upcast/callback
+    limits, aggregated over every registered report and enforced at
+    teardown.  Keywords: collective opcodes with underscores
+    (``all_gather=3``, ``all_reduce=2``, ...) bound instruction counts,
+    ``total_bytes`` bounds the summed per-device collective bytes, and
+    ``resharding_sites`` / ``dtype_upcasts`` / ``host_callbacks``
+    (default-unbounded) bound those site counts.  Same vacuous-pass
+    protection as ``compile_budget``: a marked test that never registers
+    a report fails.
 """
 
 from __future__ import annotations
 
 import pytest
 
+from diff3d_tpu.analysis.ir import COLLECTIVE_OPS, ProgramReport
 from diff3d_tpu.analysis.runtime import RecompilationSentinel
+
+#: comms_budget keyword -> how it is enforced.  Collective opcodes use
+#: underscores (valid Python keywords); None-valued limits are unset.
+_COMMS_KEYS = tuple(op.replace("-", "_") for op in COLLECTIVE_OPS) + (
+    "total_bytes", "resharding_sites", "dtype_upcasts", "host_callbacks")
+
+
+class CommsCheck:
+    """Accumulates :class:`ProgramReport`s for the ``comms_budget``
+    marker.  ``add`` takes a ready report; ``analyze`` lowers+analyzes
+    in place (thin wrapper over :func:`analyze_lowered`)."""
+
+    def __init__(self):
+        self.reports = []
+
+    def add(self, report: ProgramReport) -> ProgramReport:
+        self.reports.append(report)
+        return report
+
+    def analyze(self, name: str, lowered, **kw) -> ProgramReport:
+        from diff3d_tpu.analysis.ir import analyze_lowered
+
+        return self.add(analyze_lowered(name, lowered, **kw))
+
+    def violations(self, limits: dict) -> list:
+        """Human-readable budget breaches, aggregated over reports."""
+        counts = {op: 0 for op in COLLECTIVE_OPS}
+        total_bytes = 0
+        sites = upcasts = callbacks = 0
+        for r in self.reports:
+            for op, stat in r.collectives.items():
+                counts[op] = counts.get(op, 0) + stat.count
+            total_bytes += r.total_collective_bytes
+            sites += len(r.resharding_sites)
+            upcasts += sum(r.dtype_upcasts.values())
+            callbacks += len(r.host_callbacks)
+        out = []
+        for op in COLLECTIVE_OPS:
+            limit = limits.get(op.replace("-", "_"))
+            if limit is not None and counts[op] > limit:
+                out.append(f"{op}: {counts[op]} instruction(s) > "
+                           f"budget {limit}")
+        for key, got in (("total_bytes", total_bytes),
+                         ("resharding_sites", sites),
+                         ("dtype_upcasts", upcasts),
+                         ("host_callbacks", callbacks)):
+            limit = limits.get(key)
+            if limit is not None and got > limit:
+                out.append(f"{key}: {got} > budget {limit}")
+        return out
 
 
 def pytest_configure(config):
@@ -28,22 +90,49 @@ def pytest_configure(config):
         "compile_budget(n): the test's callables tracked via the "
         "compile_sentinel fixture may compile at most n programs "
         "(enforced at teardown)")
+    config.addinivalue_line(
+        "markers",
+        "comms_budget(all_gather=n, ..., total_bytes=n, "
+        "resharding_sites=n, dtype_upcasts=n, host_callbacks=n): the "
+        "programs analyzed via the comms_check fixture may not exceed "
+        "these collective/resharding/upcast/callback limits "
+        "(aggregated; enforced at teardown)")
 
 
 @pytest.hookimpl(tryfirst=True)
 def pytest_runtest_setup(item):
     marker = item.get_closest_marker("compile_budget")
-    if marker is None:
-        return
-    if not marker.args or not isinstance(marker.args[0], int):
-        pytest.fail(
-            f"{item.nodeid}: @pytest.mark.compile_budget needs an "
-            "integer budget, e.g. compile_budget(1)", pytrace=False)
-    if "compile_sentinel" not in item.fixturenames:
-        pytest.fail(
-            f"{item.nodeid}: @pytest.mark.compile_budget requires the "
-            "compile_sentinel fixture — request it and track the "
-            "jitted callables under test", pytrace=False)
+    if marker is not None:
+        if not marker.args or not isinstance(marker.args[0], int):
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.compile_budget needs an "
+                "integer budget, e.g. compile_budget(1)", pytrace=False)
+        if "compile_sentinel" not in item.fixturenames:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.compile_budget requires "
+                "the compile_sentinel fixture — request it and track "
+                "the jitted callables under test", pytrace=False)
+
+    marker = item.get_closest_marker("comms_budget")
+    if marker is not None:
+        if marker.args:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.comms_budget takes only "
+                f"keywords ({', '.join(_COMMS_KEYS)}), e.g. "
+                "comms_budget(all_gather=3, resharding_sites=0)",
+                pytrace=False)
+        bad = sorted(set(marker.kwargs) - set(_COMMS_KEYS))
+        if bad or not marker.kwargs:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.comms_budget got "
+                f"{'unknown keys ' + ', '.join(bad) if bad else 'no limits'}"
+                f" — valid keys: {', '.join(_COMMS_KEYS)}",
+                pytrace=False)
+        if "comms_check" not in item.fixturenames:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.comms_budget requires the "
+                "comms_check fixture — request it and analyze the "
+                "lowered programs under test", pytrace=False)
 
 
 @pytest.fixture
@@ -60,3 +149,24 @@ def compile_sentinel(request):
             "pass vacuously; call compile_sentinel.track(...)",
             pytrace=False)
     sentinel.assert_budget(marker.args[0])
+
+
+@pytest.fixture
+def comms_check(request):
+    check = CommsCheck()
+    yield check
+    marker = request.node.get_closest_marker("comms_budget")
+    if marker is None:
+        return
+    if not check.reports:
+        pytest.fail(
+            f"{request.node.nodeid}: comms_budget(...) but no program "
+            "was analyzed — the budget would pass vacuously; call "
+            "comms_check.analyze(name, lowered) or comms_check.add(r)",
+            pytrace=False)
+    violations = check.violations(marker.kwargs)
+    if violations:
+        names = ", ".join(r.name for r in check.reports)
+        pytest.fail(
+            f"{request.node.nodeid}: comms budget exceeded over "
+            f"[{names}]:\n  " + "\n  ".join(violations), pytrace=False)

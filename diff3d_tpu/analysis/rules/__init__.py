@@ -12,11 +12,15 @@ them):
   GL105  missing-static-argnums shape-like jit param left traced
   GL106  unsynced-timing        timing device work without sync
   GL107  mutable-trace-state    mutable defaults / global in trace
+  GL108  half-specified-shardings jit on a mesh path missing in/out specs
+  GL109  jit-closure-constant-capture jit closes over a local device array
 """
 
 from diff3d_tpu.analysis.rules.donation import DonatedReuseRule
 from diff3d_tpu.analysis.rules.jit_args import StaticShapeArgRule
 from diff3d_tpu.analysis.rules.rng import RngReuseRule
+from diff3d_tpu.analysis.rules.sharding import (ClosedOverArrayRule,
+                                                ShardingSpecRule)
 from diff3d_tpu.analysis.rules.state import MutableTraceStateRule
 from diff3d_tpu.analysis.rules.timing import UnsyncedTimingRule
 from diff3d_tpu.analysis.rules.tracing import HostSyncRule, TracedBranchRule
@@ -29,6 +33,8 @@ ALL_RULES = (
     StaticShapeArgRule(),
     UnsyncedTimingRule(),
     MutableTraceStateRule(),
+    ShardingSpecRule(),
+    ClosedOverArrayRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,178 @@
+"""GL108/GL109: sharding hygiene at jit boundaries.
+
+The IR analyzer (``analysis/ir.py``) audits what GSPMD actually did;
+these two rules catch the *source* patterns that most often cause what
+it flags:
+
+  * **GL108** — a ``jax.jit`` call that passes only one of
+    ``in_shardings`` / ``out_shardings``, or passes neither while the
+    wrapped function uses ``with_sharding_constraint`` internally (so it
+    is demonstrably on a mesh path).  Half-specified boundaries leave
+    the other side to sharding propagation, which silently picks
+    whatever minimises *this* program — usually replication, paid for
+    as an all-gather at the boundary.
+  * **GL109** — a jitted function closing over a concrete device array
+    built in an *enclosing function* (``jnp.array`` / ``zeros`` /
+    ``device_put`` / ``jax.random.*`` results).  Closure captures are
+    baked into the compiled program as constants: the buffer is
+    replicated onto every device, never donated, and a "new" value
+    needs a retrace.  Module-level constants are excluded (idiomatic
+    lookup tables) and attribute references (``self.w``) are out of
+    scope — the rule targets the easy-to-miss local capture.
+
+Both rules only fire on resolvable in-module functions, per the
+conservatism contract in ``rules/base.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from diff3d_tpu.analysis.rules.base import Rule
+from diff3d_tpu.analysis.rules.context import (ModuleContext, dotted_name,
+                                               param_names)
+
+_SHARDING_KWARGS = {"in_shardings", "out_shardings"}
+#: Calls whose result is a concrete (device) array when bound at
+#: function scope.  numpy constructors are deliberately excluded —
+#: closing over a host lookup table is idiomatic and the capture is
+#: intentional.
+_ARRAY_CONSTRUCTOR_PREFIXES = ("jnp.", "jax.numpy.", "jax.random.")
+_ARRAY_CONSTRUCTOR_NAMES = {"jax.device_put"}
+
+
+def _uses_sharding_constraint(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.endswith("with_sharding_constraint"):
+                return True
+    return False
+
+
+def _is_array_constructor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    name = dotted_name(value.func)
+    if name is None:
+        return False
+    return (name in _ARRAY_CONSTRUCTOR_NAMES
+            or any(name.startswith(p)
+                   for p in _ARRAY_CONSTRUCTOR_PREFIXES))
+
+
+class ShardingSpecRule(Rule):
+    id = "GL108"
+    name = "half-specified-shardings"
+    severity = "warning"
+    description = ("jit boundary on a mesh path with missing/half "
+                   "in_shardings/out_shardings")
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for site in ctx.jit_sites:
+            # A **kwargs splat may carry the specs (the sampler's
+            # `**_specs(...)` idiom) — unverifiable, stay silent.
+            if any(kw.arg is None for kw in site.call.keywords):
+                continue
+            given = {kw.arg for kw in site.call.keywords
+                     if kw.arg in _SHARDING_KWARGS}
+            if len(given) == 1:
+                missing = (_SHARDING_KWARGS - given).pop()
+                yield self.finding(
+                    ctx, site.call,
+                    f"jit passes {given.pop()} but not {missing} — the "
+                    "unspecified side is left to sharding propagation, "
+                    "which may silently replicate (all-gather at the "
+                    "boundary); specify both")
+            elif (not given and site.fn is not None
+                  and _uses_sharding_constraint(site.fn)):
+                yield self.finding(
+                    ctx, site.call,
+                    "jit wraps a function using with_sharding_constraint "
+                    "but passes neither in_shardings nor out_shardings — "
+                    "boundary placement is left to propagation; "
+                    "specify both")
+
+
+class ClosedOverArrayRule(Rule):
+    id = "GL109"
+    name = "jit-closure-constant-capture"
+    severity = "warning"
+    description = ("jitted function closes over a device array built in "
+                   "an enclosing function (baked-in replicated constant)")
+
+    def check(self, ctx: ModuleContext) -> Iterator:
+        for site in ctx.jit_sites:
+            fn = site.fn
+            if fn is None:
+                continue
+            free = _free_loads(fn)
+            if not free:
+                continue
+            scope = ctx.enclosing_function(fn)
+            while scope is not None:
+                for name, value in _own_scope_array_bindings(scope, fn):
+                    if name in free:
+                        yield self.finding(
+                            ctx, site.call,
+                            f"jitted function closes over '{name}' = "
+                            f"{_call_label(value)} built in the "
+                            "enclosing function — captured as a baked-in "
+                            "compiled constant (replicated on every "
+                            "device, retrace to change); pass it as an "
+                            "argument instead")
+                        free.discard(name)
+                scope = ctx.enclosing_function(scope)
+
+
+def _free_loads(fn: ast.AST) -> Set[str]:
+    """Names loaded in ``fn`` but neither parameters nor locally bound."""
+    bound = set(param_names(fn))
+    args = fn.args
+    for extra in (args.kwonlyargs,):
+        bound.update(a.arg for a in extra)
+    for va in (args.vararg, args.kwarg):
+        if va is not None:
+            bound.add(va.arg)
+    loads: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                loads.add(node.id)
+            else:
+                bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                bound.add(node.name)
+    return loads - bound
+
+
+def _own_scope_array_bindings(scope: ast.AST, exclude: ast.AST):
+    """``(name, value)`` for array-constructor assignments in ``scope``'s
+    own body (nested function bodies — including ``exclude`` — skipped)."""
+    out = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Assign):
+                if _is_array_constructor(child.value):
+                    for t in child.targets:
+                        if isinstance(t, ast.Name):
+                            out.append((t.id, child.value))
+            elif (isinstance(child, ast.AnnAssign)
+                  and child.value is not None
+                  and isinstance(child.target, ast.Name)
+                  and _is_array_constructor(child.value)):
+                out.append((child.target.id, child.value))
+            visit(child)
+
+    visit(scope)
+    return out
+
+
+def _call_label(value: ast.Call) -> str:
+    return f"{dotted_name(value.func) or 'an array constructor'}(...)"

@@ -86,6 +86,18 @@ class MeshEnv:
 
         return constrain
 
+    def param_spec_table(self, pytree) -> dict:
+        """Flat ``{leaf path: str(PartitionSpec)}`` of the policy's
+        intended placement — works on abstract (``ShapeDtypeStruct``)
+        templates since only shapes are read.  The human-readable side
+        of :meth:`params`, used by shardcheck's reports to say which
+        placement each param *should* have gotten."""
+        flat = jax.tree_util.tree_flatten_with_path(
+            self.params(pytree),
+            is_leaf=lambda x: isinstance(x, NamedSharding))[0]
+        return {jax.tree_util.keystr(path): str(tuple(sh.spec))
+                for path, sh in flat}
+
     def params(self, pytree) -> object:
         """Sharding pytree for params/opt-state per the config policy."""
         mode = self.cfg.param_sharding

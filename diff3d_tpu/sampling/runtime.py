@@ -396,6 +396,44 @@ class Sampler:
             jnp.asarray(record_T), jnp.asarray(steps, jnp.int32),
             jnp.asarray(K), jnp.asarray(rngs))
 
+    def lower_step_many(self, lanes: int, capacity: int, *,
+                        H: Optional[int] = None, W: Optional[int] = None):
+        """Lower the :meth:`step_many` program on ABSTRACT args (no
+        buffers staged) — the analysis hook shardcheck and bench use to
+        audit the compiled scan's collectives/dtypes per shape bucket.
+
+        ``lanes`` is the object count N (must satisfy the same
+        :attr:`lane_multiple` divisibility as a real call), ``capacity``
+        the record capacity (:func:`record_capacity`).  Returns a
+        ``jax.stages.Lowered``.  Only the single-execution path
+        (``scan_chunks == 1``) is one program; the chunked path is a
+        Python composition and has no single lowering.
+        """
+        if self.scan_chunks != 1:
+            raise ValueError(
+                "lower_step_many: scan_chunks="
+                f"{self.scan_chunks} composes multiple programs in "
+                "Python; lower a scan_chunks=1 sampler instead")
+        if lanes % self.lane_multiple:
+            raise ValueError(
+                f"lower_step_many: lanes={lanes} is not a multiple of "
+                f"the mesh's data-axis size {self.lane_multiple}")
+        B = int(self.w.shape[0])
+        H = self.cfg.model.H if H is None else int(H)
+        W = self.cfg.model.W if W is None else int(W)
+        f32, i32, u32 = jnp.float32, jnp.int32, jnp.uint32
+        sds = jax.ShapeDtypeStruct
+        abstract_params = jax.tree.map(
+            lambda x: sds(jnp.shape(x), x.dtype), self.params)
+        return self._run_view_many.lower(
+            abstract_params,
+            sds((lanes, capacity, B, H, W, 3), f32),
+            sds((lanes, capacity, 3, 3), f32),
+            sds((lanes, capacity, 3), f32),
+            sds((lanes,), i32),
+            sds((lanes, 3, 3), f32),
+            sds((lanes, 2), u32))
+
     # ------------------------------------------------------------------
     # Offline loops: thin host loops threading the device-resident carry.
     # ------------------------------------------------------------------

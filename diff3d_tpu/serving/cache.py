@@ -186,6 +186,17 @@ class ProgramCache:
         jax.block_until_ready(out)
         return time.monotonic() - t0
 
+    def lower(self, bucket, lanes: int):
+        """Lower the ``(bucket, lanes)`` view-step program on ABSTRACT
+        args (no zeros staged, nothing executed) — the analysis hook
+        shardcheck uses to audit a serving-warmup program's collectives.
+        Routes through the same schedule dispatch as :meth:`step_many`,
+        so the lowered program IS the one :meth:`warmup` would compile."""
+        sampler = self._sampler_for(bucket)
+        H, W, cap = tuple(bucket)[:3]
+        return sampler.lower_step_many(int(lanes), int(cap),
+                                       H=int(H), W=int(W))
+
     def supported_schedules(self) -> list:
         """Sorted ``"kind:steps"`` strings of the routable samplers."""
         return sorted(

@@ -155,7 +155,7 @@ def make_distill_step(model, cfg: Config, env: MeshEnv | None = None,
     rep = env.replicated()
     jitted = None
 
-    def sharded_step(state, teacher_params, batch, rng, student_steps):
+    def _jitted(state, teacher_params, batch):
         nonlocal jitted
         if jitted is None:
             st_sh = env.state_shardings(state)
@@ -166,8 +166,19 @@ def make_distill_step(model, cfg: Config, env: MeshEnv | None = None,
                               rep, rep),
                 out_shardings=(st_sh, rep),
                 donate_argnums=(0,) if donate else ())
-        return jitted(state, teacher_params, batch, rng, student_steps)
+        return jitted
 
+    def sharded_step(state, teacher_params, batch, rng, student_steps):
+        return _jitted(state, teacher_params, batch)(
+            state, teacher_params, batch, rng, student_steps)
+
+    # Same ``.lower`` surface as the env=None jit, for shardcheck —
+    # abstract (ShapeDtypeStruct) pytrees are fine, the sharding specs
+    # only map over leaves.
+    sharded_step.lower = (
+        lambda state, teacher_params, batch, rng, student_steps:
+        _jitted(state, teacher_params, batch).lower(
+            state, teacher_params, batch, rng, student_steps))
     return sharded_step
 
 

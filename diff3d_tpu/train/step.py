@@ -119,7 +119,7 @@ def make_train_step(model, cfg: Config, env: MeshEnv | None = None,
 
     jitted = None  # built on first call (shardings come from the pytrees)
 
-    def sharded_step(state, batch, rng):
+    def _jitted(state, batch):
         nonlocal jitted
         if jitted is None:
             st_sh = env.state_shardings(state)
@@ -129,6 +129,16 @@ def make_train_step(model, cfg: Config, env: MeshEnv | None = None,
                 in_shardings=(st_sh, batch_shardings, rep),
                 out_shardings=(st_sh, rep),
                 donate_argnums=(0,) if donate else ())
-        return jitted(state, batch, rng)
+        return jitted
 
+    def sharded_step(state, batch, rng):
+        return _jitted(state, batch)(state, batch, rng)
+
+    # The sharded path jits lazily inside this closure; expose the same
+    # ``.lower`` the env=None jit has so analysis tooling (shardcheck,
+    # flops_report) can lower the REAL sharded program on abstract args
+    # (ShapeDtypeStructs work — the sharding pytrees only map leaves).
+    sharded_step.lower = (
+        lambda state, batch, rng: _jitted(state, batch).lower(
+            state, batch, rng))
     return sharded_step

@@ -98,17 +98,21 @@ def main() -> None:
     float(metrics["loss"])
     dt = (time.perf_counter() - t0) / n
 
-    # The mesh-sharded step jits lazily inside a closure; lower the
-    # unsharded variant (same program modulo collectives) for analysis,
-    # on ABSTRACT args (ShapeDtypeStructs — a device_get of the full
-    # state would drag GBs over the dev tunnel).
+    # Cost/comms extraction rides the shared analysis/ir.py path (the
+    # shardcheck engine), on ABSTRACT args (ShapeDtypeStructs — a
+    # device_get of the full state would drag GBs over the dev tunnel).
+    # FLOPs come from the unsharded variant (same math modulo
+    # collectives — the global-batch number, not a per-device shard);
+    # the collective footprint comes from the REAL sharded step via its
+    # ``.lower`` hook.
+    from diff3d_tpu.analysis import ir as ir_lib
+
     fn = make_train_step(model, cfg, env=None, donate=False)
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), (state, batch))
-    traced = fn.lower(abstract[0], abstract[1], rng)
-    compiled = traced.compile()
-    ca = compiled.cost_analysis()
-    flops = ca.get("flops", float("nan")) if ca else float("nan")
+    report = ir_lib.analyze_jitted(
+        f"train_step_{args.config}", fn, abstract[0], abstract[1], rng)
+    flops = float("nan") if report.flops is None else report.flops
     tflops = flops / dt / 1e12
     print(f"config: {args.config}  batch {global_batch} x accum {accum}  "
           f"attn_impl {cfg.model.attn_impl}")
@@ -117,6 +121,20 @@ def main() -> None:
     print(f"achieved: {tflops:.1f} TFLOP/s "
           f"({100 * tflops / args.ceiling:.0f}% of the "
           f"{args.ceiling:.0f}-TFLOP/s ceiling)")
+    try:
+        sharded = ir_lib.analyze_lowered(
+            f"train_step_{args.config}_sharded",
+            step_fn.lower(abstract[0], abstract[1], rng))
+        comms = ir_lib.comms_summary(sharded)
+        per_op = ", ".join(
+            f"{op} x{c['count']} ({c['bytes'] / 1e6:.1f} MB)"
+            for op, c in comms["collectives"].items()) or "none"
+        print(f"sharded-step collectives: {per_op}")
+        print(f"sharded-step collective bytes/device/step: "
+              f"{comms['total_collective_bytes'] / 1e6:.1f} MB")
+    except Exception as e:  # comms are advisory; never kill the report
+        print(f"sharded-step comms report unavailable: "
+              f"{str(e).splitlines()[0]}")
 
 
 if __name__ == "__main__":

@@ -1,9 +1,20 @@
-"""Repo lint gate, runnable as a plain script: ``python tools/lint.py``.
+"""Repo static-analysis gate, runnable as a plain script:
+``python tools/lint.py``.
 
-Thin wrapper over ``python -m diff3d_tpu.analysis`` (graftlint) so the
-gate works from a checkout without installing the package.  All
-arguments pass through — see ``--help`` for the rule catalog and
-baseline workflow, and docs/DESIGN.md §9 for policy.
+Runs BOTH passes as one gate (nonzero exit if either finds anything
+unsuppressed):
+
+  * **graftlint** — the AST pass (rules GL1xx, docs/DESIGN.md §9);
+  * **shardcheck** — the IR pass over the tier-1 program set (rules
+    SC2xx, docs/DESIGN.md §10): lowers the mesh-sharded train step and
+    sampler ``step_many`` on 8 virtual CPU devices and diffs their
+    collectives/dtypes/param placement against the committed manifests
+    under ``runs/shardcheck/``.
+
+``--ast-only`` / ``--ir-only`` select one pass; all other arguments
+pass through to the selected pass(es) — with both passes active only
+argument-free invocation is supported (pass-specific flags differ).
+Works from a checkout without installing the package.
 """
 
 from __future__ import annotations
@@ -16,8 +27,29 @@ def main() -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo_root not in sys.path:
         sys.path.insert(0, repo_root)
-    from diff3d_tpu.analysis.lint import main as lint_main
-    return lint_main(sys.argv[1:])
+    argv = sys.argv[1:]
+    ast_only = "--ast-only" in argv
+    ir_only = "--ir-only" in argv
+    argv = [a for a in argv if a not in ("--ast-only", "--ir-only")]
+    if ast_only and ir_only:
+        print("tools/lint.py: --ast-only and --ir-only are exclusive",
+              file=sys.stderr)
+        return 2
+    if argv and not (ast_only or ir_only):
+        print("tools/lint.py: pass-through arguments need --ast-only or "
+              "--ir-only (the two passes take different flags)",
+              file=sys.stderr)
+        return 2
+
+    rc = 0
+    if not ir_only:
+        from diff3d_tpu.analysis.lint import main as lint_main
+        rc = max(rc, lint_main(argv if ast_only else []))
+    if not ast_only:
+        from diff3d_tpu.analysis.shardcheck import main as shardcheck_main
+        rc = max(rc, shardcheck_main(
+            argv if ir_only else ["--programs-tier1"]))
+    return rc
 
 
 if __name__ == "__main__":
