@@ -1,6 +1,6 @@
 """Static analysis + runtime invariants for the TPU hot paths.
 
-Three layers, one contract (DESIGN.md §9–10):
+Four layers, one contract (DESIGN.md §9–12):
 
   * ``analysis.lint`` — graftlint, the AST tracer-hygiene linter
     (``python -m diff3d_tpu.analysis`` walks diff3d_tpu/, tools/ and
@@ -12,9 +12,16 @@ Three layers, one contract (DESIGN.md §9–10):
     compiled HLO, diffed against committed budget manifests under
     ``runs/shardcheck/`` (``shardcheck`` console script; tools/lint.py
     runs both passes as one gate);
-  * ``analysis.runtime`` — the recompilation sentinel, transfer/donation
-    guards and the ``compile_budget``/``comms_budget`` pytest markers
-    that enforce the same invariants on running code.
+  * ``analysis.lockcheck`` / ``analysis.rules.concurrency`` — lockcheck,
+    the concurrency linter for the threaded serving/checkpoint runtime:
+    per-class lock-order graphs, ``# guarded-by:`` discipline, blocking
+    calls and callback invocation under locks (rules LC3xx; ``lockcheck``
+    console script, third leg of the tools/lint.py gate);
+  * ``analysis.runtime`` / ``analysis.witness`` — the recompilation
+    sentinel, transfer/donation guards and the runtime lock-order
+    witness, surfaced as the ``compile_budget``/``comms_budget``/
+    ``lock_witness`` pytest markers that enforce the same invariants on
+    running code.
 """
 
 from diff3d_tpu.analysis.ir import (ProgramReport, analyze_jitted,
@@ -22,16 +29,21 @@ from diff3d_tpu.analysis.ir import (ProgramReport, analyze_jitted,
                                     cost_summary)
 from diff3d_tpu.analysis.lint import (Finding, lint_paths, lint_source,
                                       main)
+from diff3d_tpu.analysis.lockcheck import lockcheck_paths, lockcheck_source
 from diff3d_tpu.analysis.runtime import (CompileBudgetExceeded,
                                          RecompilationSentinel,
                                          assert_consumed, assert_live,
                                          compile_budget,
                                          no_host_transfers, owned)
+from diff3d_tpu.analysis.witness import (LockWitness, WitnessViolation,
+                                         install_witness)
 
 __all__ = [
     "Finding", "lint_paths", "lint_source", "main",
+    "lockcheck_paths", "lockcheck_source",
     "ProgramReport", "analyze_lowered", "analyze_jitted",
     "comms_summary", "cost_summary",
     "RecompilationSentinel", "CompileBudgetExceeded", "compile_budget",
     "no_host_transfers", "assert_consumed", "assert_live", "owned",
+    "LockWitness", "WitnessViolation", "install_witness",
 ]

@@ -46,10 +46,19 @@ SEVERITY_WARNING = "warning"
 DEFAULT_TARGETS = ("diff3d_tpu", "tools", "bench.py")
 DEFAULT_BASELINE = ".graftlint-baseline.json"
 
-_SUPPRESS_RE = re.compile(
-    r"#\s*graftlint:\s*(disable|disable-next-line|disable-file)"
-    r"\s*=\s*(.*)$")
 _RULE_HEAD_RE = re.compile(r"\s*,?\s*([A-Za-z]+\d+|all)")
+
+
+def _suppress_re(tool: str) -> "re.Pattern[str]":
+    """The inline-suppression comment grammar, parameterised on the tool
+    tag so sibling analyzers (lockcheck) reuse the exact grammar under
+    their own namespace: ``# <tool>: disable[-next-line|-file]=RULE(r)``."""
+    return re.compile(
+        rf"#\s*{re.escape(tool)}:\s*(disable|disable-next-line|disable-file)"
+        r"\s*=\s*(.*)$")
+
+
+_SUPPRESS_RE = _suppress_re("graftlint")
 
 
 def _parse_rule_tokens(spec: str):
@@ -142,15 +151,15 @@ class Suppression:
 
 
 def _parse_suppressions(
-        lines: Sequence[str]) -> Tuple[List[Suppression],
-                                       List[Suppression],
-                                       List[Tuple[int, str]]]:
+        lines: Sequence[str],
+        suppress_re: "re.Pattern[str]" = _SUPPRESS_RE,
+) -> Tuple[List[Suppression], List[Suppression], List[Tuple[int, str]]]:
     """-> (line-scoped, file-scoped, reasonless (line, rule) pairs)."""
     line_scoped: List[Suppression] = []
     file_scoped: List[Suppression] = []
     missing_reason: List[Tuple[int, str]] = []
     for i, text in enumerate(lines, start=1):
-        m = _SUPPRESS_RE.search(text)
+        m = suppress_re.search(text)
         if not m:
             continue
         kind, spec = m.group(1), m.group(2)
@@ -173,14 +182,22 @@ def _parse_suppressions(
 
 
 def lint_source(path: str, source: str,
-                rules: Optional[Sequence] = None) -> List[Finding]:
+                rules: Optional[Sequence] = None, *,
+                tool: str = "graftlint",
+                parse_rule: str = "GL001",
+                reasonless_rule: str = "GL002") -> List[Finding]:
     """Lint one file's source text.  Returns ALL findings, suppressed
-    ones included (marked), so callers can report both sides."""
+    ones included (marked), so callers can report both sides.
+
+    ``tool`` selects the suppression-comment namespace (and the ids the
+    engine-emitted parse/reasonless findings carry) — graftlint by
+    default; lockcheck passes its own so the two analyzers' suppressions
+    never shadow each other on a shared line."""
     rules = ALL_RULES if rules is None else rules
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
-        return [Finding(path=path, rule="GL001", line=e.lineno or 1,
+        return [Finding(path=path, rule=parse_rule, line=e.lineno or 1,
                         col=e.offset or 0, severity=SEVERITY_ERROR,
                         message=f"file does not parse: {e.msg}")]
     ctx = ModuleContext(path, source, tree)
@@ -190,7 +207,8 @@ def lint_source(path: str, source: str,
             raw.append(f)
 
     line_scoped, file_scoped, missing_reason = _parse_suppressions(
-        ctx.lines)
+        ctx.lines, _suppress_re(tool) if tool != "graftlint"
+        else _SUPPRESS_RE)
     out: List[Finding] = []
     for f in sorted(raw, key=lambda f: (f.line, f.col, f.rule)):
         reason = None
@@ -212,10 +230,10 @@ def lint_source(path: str, source: str,
     # the inline comment is the audit trail.
     for line, rule in missing_reason:
         out.append(Finding(
-            path=path, rule="GL002", line=line, col=0,
+            path=path, rule=reasonless_rule, line=line, col=0,
             severity=SEVERITY_WARNING,
             message=f"suppression of {rule} has no (reason) — write "
-                    f"'# graftlint: disable={rule}(why it is safe)'"))
+                    f"'# {tool}: disable={rule}(why it is safe)'"))
     return out
 
 
@@ -235,7 +253,10 @@ def iter_python_files(targets: Iterable[str]) -> List[str]:
 
 
 def lint_paths(targets: Sequence[str],
-               rules: Optional[Sequence] = None) -> List[Finding]:
+               rules: Optional[Sequence] = None, *,
+               tool: str = "graftlint",
+               parse_rule: str = "GL001",
+               reasonless_rule: str = "GL002") -> List[Finding]:
     findings: List[Finding] = []
     for path in iter_python_files(targets):
         try:
@@ -243,11 +264,13 @@ def lint_paths(targets: Sequence[str],
                 source = f.read()
         except OSError as e:
             findings.append(Finding(
-                path=path, rule="GL001", line=1, col=0,
+                path=path, rule=parse_rule, line=1, col=0,
                 severity=SEVERITY_ERROR,
                 message=f"unreadable: {e}"))
             continue
-        findings.extend(lint_source(path, source, rules))
+        findings.extend(lint_source(path, source, rules, tool=tool,
+                                    parse_rule=parse_rule,
+                                    reasonless_rule=reasonless_rule))
     return findings
 
 
@@ -265,12 +288,12 @@ def load_baseline(path: str) -> Set[str]:
 
 
 def write_baseline(path: str, findings: Sequence[Finding],
-                   root: str) -> int:
+                   root: str, tool: str = "graftlint") -> int:
     entries = sorted({f.fingerprint(root) for f in findings
                       if not f.suppressed})
     with open(path, "w", encoding="utf-8") as f:
         json.dump({"version": 1,
-                   "tool": "graftlint",
+                   "tool": tool,
                    "entries": entries}, f, indent=1, sort_keys=True)
         f.write("\n")
     return len(entries)

@@ -24,6 +24,13 @@ package — pytest resolves the module off ``sys.path``).  Exposes:
     (default-unbounded) bound those site counts.  Same vacuous-pass
     protection as ``compile_budget``: a marked test that never registers
     a report fails.
+  * ``@pytest.mark.lock_witness`` — the test runs with the runtime
+    lock-order witness installed (every ``threading.Lock``/``RLock``/
+    ``Condition``/``Event`` created in the test body is wrapped); at
+    teardown the test fails on any lock-order cycle or held-lock wait
+    observed.  The test must request the ``lock_witness`` fixture, and
+    a marked test under which no lock was ever acquired fails — the
+    check would pass vacuously.
 """
 
 from __future__ import annotations
@@ -97,6 +104,11 @@ def pytest_configure(config):
         "programs analyzed via the comms_check fixture may not exceed "
         "these collective/resharding/upcast/callback limits "
         "(aggregated; enforced at teardown)")
+    config.addinivalue_line(
+        "markers",
+        "lock_witness: run the test with the runtime lock-order witness "
+        "installed (via the lock_witness fixture); fails at teardown on "
+        "any lock-order cycle or held-lock wait")
 
 
 @pytest.hookimpl(tryfirst=True)
@@ -134,6 +146,18 @@ def pytest_runtest_setup(item):
                 "comms_check fixture — request it and analyze the "
                 "lowered programs under test", pytrace=False)
 
+    marker = item.get_closest_marker("lock_witness")
+    if marker is not None:
+        if marker.args or marker.kwargs:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.lock_witness takes no "
+                "arguments", pytrace=False)
+        if "lock_witness" not in item.fixturenames:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.lock_witness requires the "
+                "lock_witness fixture — request it so the witness is "
+                "installed around the test body", pytrace=False)
+
 
 @pytest.fixture
 def compile_sentinel(request):
@@ -170,3 +194,29 @@ def comms_check(request):
         pytest.fail(
             f"{request.node.nodeid}: comms budget exceeded over "
             f"[{names}]:\n  " + "\n  ".join(violations), pytrace=False)
+
+
+@pytest.fixture
+def lock_witness(request):
+    from diff3d_tpu.analysis.witness import install_witness
+
+    witness, uninstall = install_witness()
+    try:
+        yield witness
+    finally:
+        uninstall()
+    marker = request.node.get_closest_marker("lock_witness")
+    if marker is None:
+        return
+    if witness.acquisitions == 0:
+        pytest.fail(
+            f"{request.node.nodeid}: @pytest.mark.lock_witness but no "
+            "witnessed lock was ever acquired — the check would pass "
+            "vacuously; the code under test must create and use its "
+            "locks while the witness is installed", pytrace=False)
+    violations = witness.violations()
+    if violations:
+        pytest.fail(
+            f"{request.node.nodeid}: lock witness found "
+            f"{len(violations)} violation(s):\n"
+            + "\n".join(violations), pytrace=False)

@@ -1,0 +1,1115 @@
+"""LC-rule pack: concurrency analysis for the threaded runtime.
+
+The serving engine, the async checkpointer and the prefetch loader are
+the only places this codebase runs real threads — and they are exactly
+the places a deadlock or a torn read cannot be caught by example-based
+tests (the interleaving that breaks is the one the test never runs).
+This module is the *static* half of lockcheck (DESIGN.md §12): a
+whole-module concurrency model shared by rules LC301–LC308, built once
+per file like graftlint's jit registry.
+
+The model:
+
+  * **Lock discovery** — ``self.X = threading.Lock()`` (and RLock /
+    Condition / Semaphore / Event / queue.Queue) attribute inits, plus
+    ``_lock = threading.Lock()`` module globals.  A ``Condition(lock)``
+    canonicalises to its underlying lock: holding the condition *is*
+    holding the lock.
+  * **guarded-by annotations** — a trailing ``# guarded-by: self._lock``
+    comment on an attribute (or global) initialiser declares the lock
+    that must be held at every access (LC302).  The same comment on a
+    ``def`` line declares a *precondition*: callers hold the lock, so
+    the method body is analysed with it held (the ``_locked``-suffix
+    internal-method convention).
+  * **Held-set dataflow** — every function is walked once with the set
+    of held locks threaded through ``with`` blocks and statement-level
+    ``.acquire()``/``.release()`` pairs.  Acquisitions while other
+    locks are held become edges in a per-class lock-order graph;
+    ``self.method()`` calls propagate acquisitions across methods
+    (fixpoint), so an A→B order buried two calls deep still closes a
+    cycle (LC301).
+
+Rules stay conservative (base.py contract): anything ambiguous —
+unknown receiver types, cross-class aliasing, locks passed as
+arguments — is left to the runtime witness (``analysis/witness.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+from diff3d_tpu.analysis.rules.base import Rule
+from diff3d_tpu.analysis.rules.context import ModuleContext, dotted_name
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+#: Factory terminal name -> kind, for threading/queue object discovery.
+_FACTORY_KINDS = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+    "Event": "event",
+    "Semaphore": "semaphore", "BoundedSemaphore": "semaphore",
+    "Queue": "queue", "LifoQueue": "queue", "PriorityQueue": "queue",
+    "SimpleQueue": "queue",
+}
+_FACTORY_MODULES = {"threading", "queue", "multiprocessing"}
+
+#: Methods that mutate a list/dict/set in place (LC308 global check).
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "clear", "remove", "discard"}
+
+#: Callback-suggesting parameter / attribute name suffixes (LC306).
+_CALLBACK_NAME_RE = re.compile(
+    r"(^|_)(callback|factory|hook|fn)$|^on_[a-z_]+$")
+
+
+def _factory_kind(node: ast.AST) -> Optional[str]:
+    """'lock' / 'condition' / ... when ``node`` is a threading-object
+    constructor call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    dn = dotted_name(node.func)
+    if dn is None:
+        return None
+    parts = dn.split(".")
+    kind = _FACTORY_KINDS.get(parts[-1])
+    if kind is None:
+        return None
+    if len(parts) == 1 or parts[0] in _FACTORY_MODULES:
+        return kind
+    return None
+
+
+def _base_key(expr: ast.AST) -> Optional[str]:
+    """Canonical receiver key: ``self.X`` -> "self.X", bare name -> name."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return f"self.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_true(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _is_false(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+@dataclasses.dataclass
+class LockDecl:
+    key: str                       # "self._lock" or module-global name
+    kind: str                      # lock | rlock | condition
+    node: ast.AST
+    canonical: str                 # conditions resolve to their lock
+
+
+@dataclasses.dataclass
+class UnitInfo:
+    """One lock-analysis unit: a class, or the module's global scope."""
+
+    name: str
+    node: ast.AST
+    is_module: bool
+    locks: Dict[str, LockDecl] = dataclasses.field(default_factory=dict)
+    events: Set[str] = dataclasses.field(default_factory=set)
+    queues: Set[str] = dataclasses.field(default_factory=set)
+    semaphores: Set[str] = dataclasses.field(default_factory=set)
+    #: attr/global key -> (canonical lock key, declaring node)
+    guarded: Dict[str, Tuple[str, ast.AST]] = dataclasses.field(
+        default_factory=dict)
+    #: guarded-by specs naming a lock the unit never declares
+    bad_guards: List[Tuple[str, ast.AST]] = dataclasses.field(
+        default_factory=list)
+    callbacks: Set[str] = dataclasses.field(default_factory=set)
+    methods: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+    #: method name -> canonical lock held on entry (def-line guarded-by)
+    preconditions: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: module unit only: module-level mutable globals (dict/list/set)
+    mutables: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class _Acquire:
+    lock: str
+    node: ast.AST
+    held: FrozenSet[str]
+    reentrant: bool
+
+
+@dataclasses.dataclass
+class _Access:
+    key: str
+    node: ast.AST
+    held: FrozenSet[str]
+    store: bool
+
+
+@dataclasses.dataclass
+class _Blocking:
+    desc: str
+    node: ast.AST
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class _CondWait:
+    cond: str
+    node: ast.AST
+    held: FrozenSet[str]
+    in_loop: bool
+
+
+@dataclasses.dataclass
+class _SelfCall:
+    method: str
+    node: ast.AST
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class _CallbackCall:
+    name: str
+    node: ast.AST
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class _JoinCall:
+    key: str                      # terminal name of the joined object
+    node: ast.AST
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class _ThreadCreate:
+    node: ast.Call
+    daemon: bool
+    bound: Optional[str]          # terminal name it is assigned to
+    target_fn: Optional[ast.AST]  # resolved target def, when local
+
+
+@dataclasses.dataclass
+class _GlobalMut:
+    name: str
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class _FnScan:
+    fn: ast.AST
+    unit: UnitInfo
+    acquires: List[_Acquire] = dataclasses.field(default_factory=list)
+    double_acquires: List[_Acquire] = dataclasses.field(
+        default_factory=list)
+    accesses: List[_Access] = dataclasses.field(default_factory=list)
+    blocking: List[_Blocking] = dataclasses.field(default_factory=list)
+    cond_waits: List[_CondWait] = dataclasses.field(default_factory=list)
+    self_calls: List[_SelfCall] = dataclasses.field(default_factory=list)
+    callback_calls: List[_CallbackCall] = dataclasses.field(
+        default_factory=list)
+    joins: List[_JoinCall] = dataclasses.field(default_factory=list)
+    threads: List[_ThreadCreate] = dataclasses.field(default_factory=list)
+    global_muts: List[_GlobalMut] = dataclasses.field(default_factory=list)
+    direct_locks: Set[str] = dataclasses.field(default_factory=set)
+
+
+class _FnScanner:
+    """One pass over a function body, threading the held-lock set."""
+
+    def __init__(self, ctx: ModuleContext, unit: UnitInfo,
+                 module_unit: UnitInfo, fn: ast.AST,
+                 pre_held: Sequence[str] = ()):
+        self.ctx = ctx
+        self.unit = unit
+        self.module_unit = module_unit
+        self.fn = fn
+        self.scan = _FnScan(fn=fn, unit=unit)
+        self.held: Set[str] = set(pre_held)
+        self.loop_depth = 0
+        self.local_locks: Dict[str, LockDecl] = {}
+        self.globals_declared: Set[str] = set()
+        self.nested: List[ast.AST] = []
+        self.callback_params = self._callback_params(fn)
+
+    @staticmethod
+    def _callback_params(fn: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return out
+        for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+            if a.arg in ("self", "cls"):
+                continue
+            ann = ast.unparse(a.annotation) if a.annotation else ""
+            if "Callable" in ann or _CALLBACK_NAME_RE.search(a.arg):
+                out.add(a.arg)
+        return out
+
+    # -- resolution -----------------------------------------------------
+
+    def resolve_lock(self, expr: ast.AST) -> Optional[LockDecl]:
+        key = _base_key(expr)
+        if key is None:
+            return None
+        if key.startswith("self."):
+            return self.unit.locks.get(key)
+        return (self.module_unit.locks.get(key)
+                or self.local_locks.get(key))
+
+    def _kind_of(self, key: Optional[str], kind_set_attr: str) -> bool:
+        if key is None:
+            return False
+        if key.startswith("self."):
+            return key in getattr(self.unit, kind_set_attr)
+        return key in getattr(self.module_unit, kind_set_attr)
+
+    # -- recording ------------------------------------------------------
+
+    def record_acquire(self, decl: LockDecl, node: ast.AST) -> None:
+        held = frozenset(self.held)
+        reentrant = decl.kind == "rlock"
+        evt = _Acquire(lock=decl.canonical, node=node, held=held,
+                       reentrant=reentrant)
+        self.scan.acquires.append(evt)
+        self.scan.direct_locks.add(decl.canonical)
+        if decl.canonical in self.held and not reentrant:
+            self.scan.double_acquires.append(evt)
+
+    def _record_attr_access(self, node: ast.Attribute) -> None:
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return
+        key = f"self.{node.attr}"
+        if key in self.unit.guarded:
+            self.scan.accesses.append(_Access(
+                key=key, node=node, held=frozenset(self.held),
+                store=isinstance(node.ctx, (ast.Store, ast.Del))))
+
+    def _record_name_access(self, node: ast.Name) -> None:
+        if node.id in self.module_unit.guarded:
+            self.scan.accesses.append(_Access(
+                key=node.id, node=node, held=frozenset(self.held),
+                store=isinstance(node.ctx, (ast.Store, ast.Del))))
+        if (node.id in self.globals_declared
+                and isinstance(node.ctx, (ast.Store, ast.Del))
+                and not self.held):
+            self.scan.global_muts.append(_GlobalMut(node.id, node))
+
+    # -- statement walk -------------------------------------------------
+
+    def scan_function(self) -> _FnScan:
+        body = getattr(self.fn, "body", None)
+        if isinstance(self.fn, ast.Lambda):
+            self.scan_expr(self.fn.body)
+        elif body is not None:
+            self.scan_block(body)
+        return self.scan
+
+    def scan_block(self, stmts: Sequence[ast.stmt]) -> None:
+        for s in stmts:
+            self.scan_stmt(s)
+
+    def scan_stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            entered: List[str] = []
+            for item in s.items:
+                self.scan_expr(item.context_expr)
+                decl = self.resolve_lock(item.context_expr)
+                if decl is not None:
+                    self.record_acquire(decl, item.context_expr)
+                    if decl.canonical not in self.held:
+                        self.held.add(decl.canonical)
+                        entered.append(decl.canonical)
+            self.scan_block(s.body)
+            for key in entered:
+                self.held.discard(key)
+        elif isinstance(s, ast.While):
+            self.scan_expr(s.test)
+            self.loop_depth += 1
+            self.scan_block(s.body)
+            self.loop_depth -= 1
+            self.scan_block(s.orelse)
+        elif isinstance(s, ast.For):
+            self.scan_expr(s.iter)
+            self.scan_expr(s.target)
+            self.scan_block(s.body)
+            self.scan_block(s.orelse)
+        elif isinstance(s, ast.If):
+            self.scan_expr(s.test)
+            self.scan_block(s.body)
+            self.scan_block(s.orelse)
+        elif isinstance(s, ast.Try):
+            self.scan_block(s.body)
+            for h in s.handlers:
+                if h.type is not None:
+                    self.scan_expr(h.type)
+                self.scan_block(h.body)
+            self.scan_block(s.orelse)
+            self.scan_block(s.finalbody)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.nested.append(s)
+        elif isinstance(s, ast.ClassDef):
+            pass  # nested classes: out of scope for this pass
+        elif isinstance(s, ast.Global):
+            self.globals_declared.update(s.names)
+        elif isinstance(s, ast.Assign):
+            self.scan_expr(s.value)
+            kind = _factory_kind(s.value)
+            if (kind in ("lock", "rlock")
+                    and len(s.targets) == 1
+                    and isinstance(s.targets[0], ast.Name)):
+                name = s.targets[0].id
+                self.local_locks[name] = LockDecl(
+                    key=name, kind=kind, node=s.value, canonical=name)
+            for t in s.targets:
+                self.scan_expr(t)
+        elif isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            # Statement-level acquire()/release() adjust the held set.
+            call = s.value
+            if isinstance(call.func, ast.Attribute):
+                decl = self.resolve_lock(call.func.value)
+                if decl is not None and call.func.attr == "acquire":
+                    if not self._nonblocking_acquire(call):
+                        self.record_acquire(decl, call)
+                        self.held.add(decl.canonical)
+                    for a in call.args:
+                        self.scan_expr(a)
+                    for kw in call.keywords:
+                        self.scan_expr(kw.value)
+                    return
+                if decl is not None and call.func.attr == "release":
+                    self.held.discard(decl.canonical)
+                    return
+            self.scan_expr(call)
+        else:
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self.scan_expr(child)
+                elif isinstance(child, ast.stmt):
+                    self.scan_stmt(child)
+
+    @staticmethod
+    def _nonblocking_acquire(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return True
+            if kw.arg == "blocking" and _is_false(kw.value):
+                return True
+        return bool(call.args) and _is_false(call.args[0])
+
+    # -- expression walk ------------------------------------------------
+
+    def scan_expr(self, node: Optional[ast.AST]) -> None:
+        if node is None or isinstance(node, ast.Constant):
+            return
+        if isinstance(node, ast.Lambda):
+            self.nested.append(node)
+            return
+        if isinstance(node, ast.Call):
+            self.handle_call(node)
+            return
+        if isinstance(node, ast.Attribute):
+            self._record_attr_access(node)
+            self.scan_expr(node.value)
+            return
+        if isinstance(node, ast.Name):
+            self._record_name_access(node)
+            return
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, (ast.Store, ast.Del))
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.module_unit.mutables
+                and not self.held):
+            self.scan_expr(node.slice)
+            self.scan_expr(node.value)
+            self.scan.global_muts.append(
+                _GlobalMut(node.value.id, node))
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.scan_expr(child)
+            elif isinstance(child, ast.comprehension):
+                self.scan_expr(child.iter)
+                self.scan_expr(child.target)
+                for i in child.ifs:
+                    self.scan_expr(i)
+            elif isinstance(child, ast.keyword):
+                self.scan_expr(child.value)
+
+    def handle_call(self, call: ast.Call) -> None:
+        held = frozenset(self.held)
+        dn = dotted_name(call.func)
+
+        if dn is not None and self._is_thread_ctor(dn):
+            self._record_thread(call)
+        elif dn in ("time.sleep", "jax.block_until_ready") and held:
+            self.scan.blocking.append(_Blocking(dn, call, held))
+        elif dn is not None and held and (
+                dn.startswith("urllib.request.")
+                or dn.startswith("requests.")
+                or dn in ("socket.create_connection",)):
+            self.scan.blocking.append(
+                _Blocking(f"{dn} (network I/O)", call, held))
+
+        if isinstance(call.func, ast.Attribute):
+            self._handle_method_call(call, call.func, held)
+        elif isinstance(call.func, ast.Name):
+            if call.func.id in self.callback_params and held:
+                self.scan.callback_calls.append(_CallbackCall(
+                    call.func.id, call, held))
+
+        self.scan_expr(call.func)
+        for a in call.args:
+            self.scan_expr(a)
+        for kw in call.keywords:
+            self.scan_expr(kw.value)
+
+    @staticmethod
+    def _is_thread_ctor(dn: str) -> bool:
+        parts = dn.split(".")
+        return parts[-1] == "Thread" and (
+            len(parts) == 1 or parts[0] in _FACTORY_MODULES)
+
+    def _record_thread(self, call: ast.Call) -> None:
+        daemon = any(kw.arg == "daemon" and _is_true(kw.value)
+                     for kw in call.keywords)
+        bound: Optional[str] = None
+        parent = self.ctx.parent.get(id(call))
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    bound = t.id
+                elif isinstance(t, ast.Attribute):
+                    bound = t.attr
+        target_fn: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg != "target":
+                continue
+            tkey = _base_key(kw.value)
+            if tkey is None:
+                continue
+            if tkey.startswith("self."):
+                target_fn = self.unit.methods.get(tkey[5:])
+            else:
+                target_fn = self.ctx.resolve_local(call, tkey)
+        self.scan.threads.append(_ThreadCreate(
+            node=call, daemon=daemon, bound=bound, target_fn=target_fn))
+
+    def _handle_method_call(self, call: ast.Call, func: ast.Attribute,
+                            held: FrozenSet[str]) -> None:
+        meth = func.attr
+        base = func.value
+        key = _base_key(base)
+        decl = self.resolve_lock(base)
+
+        if decl is not None:
+            if meth in ("wait", "wait_for") and decl.kind == "condition":
+                others = held - {decl.canonical}
+                if meth == "wait":
+                    self.scan.cond_waits.append(_CondWait(
+                        cond=decl.canonical, node=call, held=held,
+                        in_loop=self.loop_depth > 0))
+                if others:
+                    self.scan.blocking.append(_Blocking(
+                        f"Condition.{meth} while holding "
+                        f"{', '.join(sorted(others))}", call, others))
+            return
+
+        if self._kind_of(key, "events"):
+            if meth == "wait" and held:
+                self.scan.blocking.append(_Blocking(
+                    f"{key}.wait (Event.wait)", call, held))
+            return
+        if self._kind_of(key, "queues"):
+            if meth in ("get", "put") and held \
+                    and not self._bounded_queue_call(call):
+                self.scan.blocking.append(_Blocking(
+                    f"{key}.{meth} without timeout", call, held))
+            elif meth == "join" and held:
+                self.scan.blocking.append(_Blocking(
+                    f"{key}.join (queue drain)", call, held))
+            return
+        if self._kind_of(key, "semaphores"):
+            if meth == "acquire" and held \
+                    and not self._nonblocking_acquire(call):
+                self.scan.blocking.append(_Blocking(
+                    f"{key}.acquire (semaphore)", call, held))
+            return
+
+        if meth == "block_until_ready" and held:
+            self.scan.blocking.append(_Blocking(
+                ".block_until_ready()", call, held))
+        elif meth == "join" and key is not None:
+            self.scan.joins.append(_JoinCall(
+                key=key.split(".")[-1], node=call, held=held))
+
+        if (isinstance(base, ast.Name) and base.id == "self"
+                and meth in self.unit.callbacks and held):
+            self.scan.callback_calls.append(_CallbackCall(
+                f"self.{meth}", call, held))
+        elif (isinstance(base, ast.Name) and base.id == "self"
+              and meth in self.unit.methods):
+            self.scan.self_calls.append(_SelfCall(meth, call, held))
+
+    @staticmethod
+    def _bounded_queue_call(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "timeout" and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None):
+                return True
+            if kw.arg == "block" and _is_false(kw.value):
+                return True
+        return False
+
+
+class ConcurrencyModel:
+    """The whole-module concurrency model, built once and memoised on
+    the :class:`ModuleContext` (mirrors the jit-registry pattern)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.module_unit = UnitInfo(name="<module>", node=ctx.tree,
+                                    is_module=True)
+        self.class_units: List[UnitInfo] = []
+        self.scans: List[_FnScan] = []
+        #: terminal names something calls ``.join()`` on, module-wide
+        self.join_names: Set[str] = set()
+
+        self._collect_units()
+        self._collect_guarded()
+        self._scan_functions()
+        for scan in self.scans:
+            for j in scan.joins:
+                self.join_names.add(j.key)
+
+    # -- pass 1: object discovery --------------------------------------
+
+    def _collect_units(self) -> None:
+        tree = self.ctx.tree
+        for stmt in tree.body:
+            self._collect_module_stmt(stmt)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self.class_units.append(self._collect_class(node))
+
+    def _collect_module_stmt(self, stmt: ast.stmt) -> None:
+        unit = self.module_unit
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name, value = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and stmt.value is not None:
+            name, value = stmt.target.id, stmt.value
+        else:
+            return
+        kind = _factory_kind(value)
+        if kind in ("lock", "rlock"):
+            unit.locks[name] = LockDecl(key=name, kind=kind, node=value,
+                                        canonical=name)
+        elif kind == "condition":
+            under = _base_key(value.args[0]) if value.args else None
+            unit.locks[name] = LockDecl(
+                key=name, kind="condition", node=value,
+                canonical=under if under else name)
+        elif kind == "event":
+            unit.events.add(name)
+        elif kind == "queue":
+            unit.queues.add(name)
+        elif kind == "semaphore":
+            unit.semaphores.add(name)
+        elif isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                                ast.ListComp, ast.SetComp)):
+            unit.mutables.add(name)
+        elif isinstance(value, ast.Call) \
+                and dotted_name(value.func) in ("dict", "list", "set",
+                                                "collections.OrderedDict",
+                                                "collections.defaultdict",
+                                                "collections.deque"):
+            unit.mutables.add(name)
+
+    def _collect_class(self, cls: ast.ClassDef) -> UnitInfo:
+        unit = UnitInfo(name=cls.name, node=cls, is_module=False)
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                unit.methods[stmt.name] = stmt
+        for method in unit.methods.values():
+            for node in ast.walk(method):
+                self._collect_attr_init(unit, method, node)
+        # Second look for conditions: their underlying lock may have
+        # been declared after them in source order.
+        for decl in unit.locks.values():
+            if decl.kind == "condition" and decl.canonical != decl.key \
+                    and decl.canonical not in unit.locks:
+                decl.canonical = decl.key
+        return unit
+
+    def _collect_attr_init(self, unit: UnitInfo, method: ast.AST,
+                           node: ast.AST) -> None:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value, ann = node.targets[0], node.value, None
+        elif isinstance(node, ast.AnnAssign):
+            target, value, ann = node.target, node.value, node.annotation
+        else:
+            return
+        if not (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            return
+        key = f"self.{target.attr}"
+        kind = _factory_kind(value) if value is not None else None
+        if kind in ("lock", "rlock"):
+            unit.locks[key] = LockDecl(key=key, kind=kind, node=value,
+                                       canonical=key)
+        elif kind == "condition":
+            under = _base_key(value.args[0]) if value.args else None
+            unit.locks[key] = LockDecl(
+                key=key, kind="condition", node=value,
+                canonical=under if under else key)
+        elif kind == "event":
+            unit.events.add(key)
+        elif kind == "queue":
+            unit.queues.add(key)
+        elif kind == "semaphore":
+            unit.semaphores.add(key)
+        # Callback attrs: annotated Callable, or assigned from a
+        # callback-named / Callable-annotated parameter of the method.
+        ann_src = ast.unparse(ann) if ann is not None else ""
+        if "Callable" in ann_src:
+            unit.callbacks.add(target.attr)
+        elif isinstance(value, ast.Name):
+            margs = getattr(method, "args", None)
+            params = (margs.posonlyargs + margs.args + margs.kwonlyargs
+                      if margs is not None else [])
+            for a in params:
+                if a.arg != value.id:
+                    continue
+                p_ann = ast.unparse(a.annotation) if a.annotation else ""
+                if "Callable" in p_ann \
+                        or _CALLBACK_NAME_RE.search(a.arg):
+                    unit.callbacks.add(target.attr)
+
+    # -- pass 2: guarded-by annotations --------------------------------
+
+    def _unit_for(self, node: ast.AST) -> UnitInfo:
+        cur = self.ctx.parent.get(id(node))
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                for u in self.class_units:
+                    if u.node is cur:
+                        return u
+            cur = self.ctx.parent.get(id(cur))
+        return self.module_unit
+
+    @staticmethod
+    def _annotation_line(node: ast.AST,
+                         annotated: Dict[int, str]) -> Optional[int]:
+        """The guarded-by comment line this statement owns, if any: any
+        signature line of a ``def``, or the first/last line of an
+        assignment (multiline initialisers put the comment after the
+        closing paren)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            first = node.lineno
+            last = node.body[0].lineno - 1 if node.body else node.lineno
+            for line in range(first, last + 1):
+                if line in annotated:
+                    return line
+            return None
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            for line in (node.lineno, node.end_lineno):
+                if line in annotated:
+                    return line
+        return None
+
+    def _collect_guarded(self) -> None:
+        annotated: Dict[int, str] = {}
+        for i, text in enumerate(self.ctx.lines, start=1):
+            m = _GUARDED_BY_RE.search(text)
+            if m:
+                annotated[i] = m.group(1)
+        if not annotated:
+            return
+        for node in ast.walk(self.ctx.tree):
+            line = self._annotation_line(node, annotated)
+            if line is None:
+                continue
+            spec = annotated[line]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                unit = self._unit_for(node)
+                decl = unit.locks.get(spec) \
+                    or self.module_unit.locks.get(spec)
+                if decl is None:
+                    unit.bad_guards.append((spec, node))
+                else:
+                    unit.preconditions[node.name] = decl.canonical
+                del annotated[line]
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    key = _base_key(t)
+                    if key is None:
+                        continue
+                    unit = (self._unit_for(node)
+                            if key.startswith("self.")
+                            else self.module_unit)
+                    decl = unit.locks.get(spec) \
+                        or self.module_unit.locks.get(spec)
+                    if decl is None:
+                        unit.bad_guards.append((spec, node))
+                    else:
+                        unit.guarded[key] = (decl.canonical, node)
+                if line in annotated:
+                    del annotated[line]
+
+    # -- pass 3: function scans ----------------------------------------
+
+    def _scan_functions(self) -> None:
+        pending: List[Tuple[UnitInfo, ast.AST, Tuple[str, ...]]] = []
+        for unit in self.class_units:
+            for name, method in unit.methods.items():
+                pre = unit.preconditions.get(name)
+                pending.append((unit, method, (pre,) if pre else ()))
+        for stmt in self.ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pre = self.module_unit.preconditions.get(stmt.name)
+                pending.append((self.module_unit, stmt,
+                                (pre,) if pre else ()))
+        seen: Set[int] = set()
+        while pending:
+            unit, fn, pre = pending.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            scanner = _FnScanner(self.ctx, unit, self.module_unit, fn,
+                                 pre_held=pre)
+            self.scans.append(scanner.scan_function())
+            # Nested defs (thread targets, closures) run on their own
+            # stack: scanned with an empty held set, same unit.
+            for nested in scanner.nested:
+                pending.append((unit, nested, ()))
+
+    # -- derived views --------------------------------------------------
+
+    def unit_scans(self, unit: UnitInfo) -> List[_FnScan]:
+        return [s for s in self.scans if s.unit is unit]
+
+    def may_acquire(self, unit: UnitInfo) -> Dict[str, Set[str]]:
+        """Method name -> locks it may acquire, transitively through
+        ``self.method()`` calls (fixpoint)."""
+        direct: Dict[str, Set[str]] = {}
+        calls: Dict[str, Set[str]] = {}
+        for scan in self.unit_scans(unit):
+            name = getattr(scan.fn, "name", None)
+            if name is None or scan.fn is not unit.methods.get(name):
+                continue
+            direct.setdefault(name, set()).update(scan.direct_locks)
+            calls.setdefault(name, set()).update(
+                c.method for c in scan.self_calls)
+        out = {m: set(locks) for m, locks in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for m, callees in calls.items():
+                for callee in callees:
+                    extra = out.get(callee, set()) - out[m]
+                    if extra:
+                        out[m].update(extra)
+                        changed = True
+        return out
+
+    def order_edges(self, unit: UnitInfo) -> Dict[Tuple[str, str],
+                                                  ast.AST]:
+        """Lock-order edges (held -> acquired) within one unit,
+        including acquisitions reached through self-method calls."""
+        edges: Dict[Tuple[str, str], ast.AST] = {}
+        may = self.may_acquire(unit) if not unit.is_module else {}
+        for scan in self.unit_scans(unit):
+            for acq in scan.acquires:
+                for h in acq.held:
+                    if h != acq.lock:
+                        edges.setdefault((h, acq.lock), acq.node)
+            for call in scan.self_calls:
+                if not call.held:
+                    continue
+                for b in may.get(call.method, ()):
+                    for h in call.held:
+                        if h != b:
+                            edges.setdefault((h, b), call.node)
+        if unit.is_module:
+            # Module functions propagate through bare-name calls too —
+            # approximate with direct acquires only (conservative).
+            pass
+        return edges
+
+    @staticmethod
+    def find_cycles(edges: Dict[Tuple[str, str], ast.AST]
+                    ) -> List[List[str]]:
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+        cycles: List[List[str]] = []
+        seen_cycles: Set[FrozenSet[str]] = set()
+
+        def dfs(node: str, path: List[str], on_path: Set[str],
+                done: Set[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        cycles.append(cyc)
+                    continue
+                if nxt in done:
+                    continue
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(nxt, path, on_path, done)
+                on_path.discard(nxt)
+                path.pop()
+            done.add(node)
+
+        done: Set[str] = set()
+        for start in sorted(adj):
+            if start not in done:
+                dfs(start, [start], {start}, done)
+        return cycles
+
+
+def model_for(ctx: ModuleContext) -> ConcurrencyModel:
+    cached = getattr(ctx, "_concurrency_model", None)
+    if cached is None:
+        cached = ConcurrencyModel(ctx)
+        ctx._concurrency_model = cached
+    return cached
+
+
+# ---------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------
+
+
+class LockOrderCycleRule(Rule):
+    id = "LC301"
+    name = "lock-order-cycle"
+    severity = "error"
+    description = ("two locks of one class are acquired in both orders "
+                   "on different paths — a deadlock waiting for the "
+                   "right interleaving")
+
+    def check(self, ctx: ModuleContext) -> Iterator["Finding"]:
+        model = model_for(ctx)
+        for unit in [model.module_unit] + model.class_units:
+            edges = model.order_edges(unit)
+            for cyc in model.find_cycles(edges):
+                # Anchor the finding at the first edge of the cycle.
+                node = edges.get((cyc[0], cyc[1]), unit.node)
+                yield self.finding(
+                    ctx, node,
+                    f"lock-order cycle in {unit.name}: "
+                    f"{' -> '.join(cyc)} — acquire these locks in one "
+                    f"global order")
+
+
+class GuardedByRule(Rule):
+    id = "LC302"
+    name = "unguarded-access"
+    severity = "error"
+    description = ("state annotated '# guarded-by: <lock>' is accessed "
+                   "without that lock held")
+
+    def check(self, ctx: ModuleContext) -> Iterator["Finding"]:
+        model = model_for(ctx)
+        for unit in [model.module_unit] + model.class_units:
+            for spec, node in unit.bad_guards:
+                yield self.finding(
+                    ctx, node,
+                    f"guarded-by names '{spec}' which is not a lock "
+                    f"declared in {unit.name}", severity="warning")
+            for scan in model.unit_scans(unit):
+                fn_name = getattr(scan.fn, "name", None)
+                if fn_name == "__init__" and not unit.is_module:
+                    continue  # single-threaded construction
+                for acc in scan.accesses:
+                    entry = unit.guarded.get(acc.key) \
+                        or model.module_unit.guarded.get(acc.key)
+                    if entry is None:
+                        continue
+                    lock, _decl = entry
+                    if lock in acc.held:
+                        continue
+                    verb = "written" if acc.store else "read"
+                    yield self.finding(
+                        ctx, acc.node,
+                        f"{acc.key} is guarded by {lock} but {verb} "
+                        f"here without it (in "
+                        f"{fn_name or '<lambda>'})")
+
+
+class BlockingUnderLockRule(Rule):
+    id = "LC303"
+    name = "blocking-under-lock"
+    severity = "error"
+    description = ("a blocking call (Event.wait, unbounded queue "
+                   "get/put, sleep, device sync, join, network I/O) "
+                   "runs while a lock is held")
+
+    def check(self, ctx: ModuleContext) -> Iterator["Finding"]:
+        model = model_for(ctx)
+        for scan in model.scans:
+            for b in scan.blocking:
+                yield self.finding(
+                    ctx, b.node,
+                    f"blocking call {b.desc} while holding "
+                    f"{', '.join(sorted(b.held))} — every other thread "
+                    f"needing that lock stalls behind it")
+            # Thread joins under a lock: only flag receivers we have
+            # seen created as threads in this module.
+            thread_names = {t.bound for s in model.scans
+                            for t in s.threads if t.bound}
+            for j in scan.joins:
+                if j.held and j.key in thread_names:
+                    yield self.finding(
+                        ctx, j.node,
+                        f"joining thread '{j.key}' while holding "
+                        f"{', '.join(sorted(j.held))}")
+
+
+class WaitWithoutPredicateRule(Rule):
+    id = "LC304"
+    name = "wait-without-predicate"
+    severity = "error"
+    description = ("Condition.wait outside a while-predicate loop — "
+                   "spurious wakeups and stolen notifications break it")
+
+    def check(self, ctx: ModuleContext) -> Iterator["Finding"]:
+        model = model_for(ctx)
+        for scan in model.scans:
+            for w in scan.cond_waits:
+                if not w.in_loop:
+                    yield self.finding(
+                        ctx, w.node,
+                        f"Condition.wait on {w.cond} is not inside a "
+                        f"while-predicate loop; use "
+                        f"'while not pred: cv.wait()'")
+
+
+class ThreadLeakRule(Rule):
+    id = "LC305"
+    name = "thread-leak"
+    severity = "warning"
+    description = ("threading.Thread with neither daemon=True nor a "
+                   "reachable join — it outlives shutdown")
+
+    def check(self, ctx: ModuleContext) -> Iterator["Finding"]:
+        model = model_for(ctx)
+        for scan in model.scans:
+            for t in scan.threads:
+                if t.daemon:
+                    continue
+                if t.bound is not None and t.bound in model.join_names:
+                    continue
+                yield self.finding(
+                    ctx, t.node,
+                    "thread is neither daemon=True nor joined anywhere "
+                    "in this module — it will outlive close()/shutdown")
+
+
+class CallbackUnderLockRule(Rule):
+    id = "LC306"
+    name = "callback-under-lock"
+    severity = "error"
+    description = ("a user-supplied callback is invoked while holding "
+                   "the lock that registered it — re-entrancy deadlock")
+
+    def check(self, ctx: ModuleContext) -> Iterator["Finding"]:
+        model = model_for(ctx)
+        for scan in model.scans:
+            for c in scan.callback_calls:
+                yield self.finding(
+                    ctx, c.node,
+                    f"callback {c.name}() invoked while holding "
+                    f"{', '.join(sorted(c.held))} — a callback that "
+                    f"calls back in deadlocks; capture under the lock, "
+                    f"invoke after release")
+
+
+class DoubleAcquireRule(Rule):
+    id = "LC307"
+    name = "double-acquire"
+    severity = "error"
+    description = ("a non-reentrant Lock is acquired on a path that "
+                   "already holds it — self-deadlock")
+
+    def check(self, ctx: ModuleContext) -> Iterator["Finding"]:
+        model = model_for(ctx)
+        for scan in model.scans:
+            for d in scan.double_acquires:
+                yield self.finding(
+                    ctx, d.node,
+                    f"{d.lock} is already held here; threading.Lock is "
+                    f"not reentrant — this deadlocks the calling "
+                    f"thread")
+        for unit in model.class_units:
+            may = model.may_acquire(unit)
+            for scan in model.unit_scans(unit):
+                for call in scan.self_calls:
+                    reacq = call.held & may.get(call.method, set())
+                    for lock in sorted(reacq):
+                        decl = unit.locks.get(lock) \
+                            or model.module_unit.locks.get(lock)
+                        if decl is not None and decl.kind == "rlock":
+                            continue
+                        yield self.finding(
+                            ctx, call.node,
+                            f"self.{call.method}() may re-acquire "
+                            f"{lock}, already held here — deadlock on "
+                            f"a non-reentrant Lock")
+
+
+class UnguardedGlobalMutationRule(Rule):
+    id = "LC308"
+    name = "unguarded-global-mutation"
+    severity = "error"
+    description = ("a thread target mutates a shared module global "
+                   "without holding any lock")
+
+    def check(self, ctx: ModuleContext) -> Iterator["Finding"]:
+        model = model_for(ctx)
+        target_ids = {id(t.target_fn) for s in model.scans
+                      for t in s.threads if t.target_fn is not None}
+        if not target_ids:
+            return
+        for scan in model.scans:
+            if id(scan.fn) not in target_ids:
+                continue
+            for m in scan.global_muts:
+                yield self.finding(
+                    ctx, m.node,
+                    f"module global '{m.name}' mutated from a thread "
+                    f"target without holding a lock — racing writes "
+                    f"tear state")
+
+
+LC_RULES = (
+    LockOrderCycleRule(),
+    GuardedByRule(),
+    BlockingUnderLockRule(),
+    WaitWithoutPredicateRule(),
+    ThreadLeakRule(),
+    CallbackUnderLockRule(),
+    DoubleAcquireRule(),
+    UnguardedGlobalMutationRule(),
+)
+
+LC_RULES_BY_ID = {r.id: r for r in LC_RULES}
+
+__all__ = ["LC_RULES", "LC_RULES_BY_ID", "ConcurrencyModel", "model_for"]

@@ -1,0 +1,450 @@
+"""Runtime lock-order witness for the threaded serving/checkpoint runtime.
+
+lockcheck (``analysis/rules/concurrency.py``) proves lock discipline
+*statically*, but it is deliberately conservative: aliased locks, locks
+passed across modules and orderings that only exist at runtime are
+outside its model.  This module covers that blind spot by *watching* a
+live run: every ``threading.Lock``/``RLock``/``Condition``/``Event``
+created while the witness is installed is wrapped, each thread's stack
+of held locks is tracked, and every "acquired B while holding A" pair
+becomes an edge in a global lock-order graph.  At check time:
+
+  * a **cycle** in the graph means two code paths acquire the same locks
+    in opposite orders — a latent deadlock, reported with the stacks
+    that created each edge, even if the interleaving that would deadlock
+    never happened in this run;
+  * a **held-lock wait** (``Event.wait`` holding any witness lock, or
+    ``Condition.wait`` holding locks *other than* the condition's own)
+    is the runtime mirror of static rule LC303.
+
+Usage — direct::
+
+    witness, uninstall = install_witness()
+    try:
+        ...  # construct + exercise the threaded system under test
+    finally:
+        uninstall()
+    witness.check()   # raises WitnessViolation on cycles / bad waits
+
+or via pytest (``analysis/pytest_plugin.py``)::
+
+    @pytest.mark.lock_witness
+    def test_engine_shutdown(lock_witness):
+        ...  # locks created in the test body are witnessed
+
+Only locks **created while installed** are witnessed (the wrappers are
+handed out by the patched factories); module-level locks created at
+import time are invisible to the witness — keep those on the static
+side via ``# guarded-by:`` annotations.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+# Originals captured at import time: the wrappers and the witness's own
+# bookkeeping must never route through the patched factories.
+_OrigLock = threading.Lock
+_OrigRLock = threading.RLock
+_OrigCondition = threading.Condition
+
+_THIS_FILE = __file__
+_THREADING_FILE = threading.__file__
+
+
+class WitnessViolation(AssertionError):
+    """Raised by :meth:`LockWitness.check` on a lock-order cycle or a
+    held-lock wait."""
+
+
+def _site_name(kind: str, seq: int) -> str:
+    """``Lock#3@engine.py:88`` — creation site of the wrapper, skipping
+    witness/threading internals so the name points at user code."""
+    for frame in reversed(traceback.extract_stack()):
+        if frame.filename in (_THIS_FILE, _THREADING_FILE):
+            continue
+        short = frame.filename.rsplit("/", 1)[-1]
+        return f"{kind}#{seq}@{short}:{frame.lineno}"
+    return f"{kind}#{seq}"
+
+
+def _stack_summary(limit: int = 6) -> Tuple[str, ...]:
+    frames = [f for f in traceback.extract_stack()
+              if f.filename not in (_THIS_FILE, _THREADING_FILE)]
+    return tuple(f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno} "
+                 f"in {f.name}" for f in frames[-limit:])
+
+
+class LockWitness:
+    """Global lock-order DAG + per-thread held stacks.
+
+    All mutable state is guarded by ``_reg`` (an *original* lock), except
+    the per-thread held stacks which live in a ``threading.local`` and
+    are only touched by their owning thread.
+    """
+
+    def __init__(self):
+        self._reg = _OrigLock()
+        # (held_key, acquired_key) -> example stack at the acquire
+        self._edges: Dict[Tuple[int, int], Tuple[str, ...]] = {}
+        self._names: Dict[int, str] = {}
+        self._acquisitions = 0
+        self._wait_violations: List[str] = []
+        self._seq = 0
+        self._tls = threading.local()
+
+    # -- registration ---------------------------------------------------
+
+    def _register(self, kind: str) -> Tuple[int, str]:
+        with self._reg:
+            self._seq += 1
+            seq = self._seq
+        name = _site_name(kind, seq)
+        with self._reg:
+            self._names[seq] = name
+        return seq, name
+
+    def _held(self) -> List[int]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    # -- event hooks (called by the wrappers) ---------------------------
+
+    def _note_acquire(self, key: int, reentrant: bool) -> None:
+        held = self._held()
+        first = key not in held
+        if first:
+            stack = _stack_summary()
+            with self._reg:
+                self._acquisitions += 1
+                for h in held:
+                    if h != key and (h, key) not in self._edges:
+                        self._edges[(h, key)] = stack
+        elif not reentrant:
+            # Re-acquiring a non-reentrant Lock the thread already holds
+            # would deadlock for real; the raw acquire already succeeded
+            # here only if another thread released it in between (i.e.
+            # the wrapper is shared in a way the witness can't model), so
+            # just count it.
+            with self._reg:
+                self._acquisitions += 1
+        held.append(key)
+
+    def _note_release(self, key: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == key:
+                del held[i]
+                return
+
+    def _drop_for_wait(self, key: int) -> int:
+        """Remove every recursion level of ``key`` from the held stack
+        (``Condition.wait`` fully releases the underlying lock); returns
+        the count so the wake path can restore it."""
+        held = self._held()
+        n = held.count(key)
+        self._tls.stack = [h for h in held if h != key]
+        return n
+
+    def _restore_after_wait(self, key: int, n: int) -> None:
+        self._held().extend([key] * n)
+
+    def _note_wait(self, kind: str, own_key: Optional[int]) -> None:
+        held = [h for h in self._held() if h != own_key]
+        if not held:
+            return
+        with self._reg:
+            names = ", ".join(self._names.get(h, str(h)) for h in held)
+            site = "; ".join(_stack_summary(3))
+            self._wait_violations.append(
+                f"{kind} in thread {threading.current_thread().name!r} "
+                f"while holding [{names}] ({site})")
+
+    # -- results --------------------------------------------------------
+
+    @property
+    def acquisitions(self) -> int:
+        with self._reg:
+            return self._acquisitions
+
+    @property
+    def wait_violations(self) -> List[str]:
+        with self._reg:
+            return list(self._wait_violations)
+
+    def cycles(self) -> List[List[str]]:
+        """Every distinct cycle in the lock-order graph, as lists of
+        lock names (first node repeated at the end)."""
+        with self._reg:
+            edges = dict(self._edges)
+            names = dict(self._names)
+        adj: Dict[int, List[int]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        out: List[List[str]] = []
+        seen_sets = set()
+
+        def dfs(node: int, path: List[int], on_path: set) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        out.append([names.get(k, str(k)) for k in cyc])
+                elif nxt not in visited:
+                    visited.add(nxt)
+                    on_path.add(nxt)
+                    dfs(nxt, path + [nxt], on_path)
+                    on_path.discard(nxt)
+
+        visited: set = set()
+        for start in sorted(adj):
+            if start not in visited:
+                visited.add(start)
+                dfs(start, [start], {start})
+        return out
+
+    def violations(self) -> List[str]:
+        msgs = []
+        for cyc in self.cycles():
+            chain = " -> ".join(cyc)
+            with self._reg:
+                detail = []
+                # Attach the acquire stack of one edge per cycle so the
+                # report points at code, not just lock names.
+                name_to_key = {v: k for k, v in self._names.items()}
+                for a, b in zip(cyc, cyc[1:]):
+                    stack = self._edges.get(
+                        (name_to_key.get(a), name_to_key.get(b)))
+                    if stack:
+                        detail.append(f"  {a} -> {b} acquired at: "
+                                      + " <- ".join(reversed(stack)))
+            msgs.append("lock-order cycle: " + chain
+                        + ("\n" + "\n".join(detail) if detail else ""))
+        msgs.extend(f"held-lock wait: {v}" for v in self.wait_violations)
+        return msgs
+
+    def check(self) -> None:
+        """Raise :class:`WitnessViolation` if any cycle or held-lock
+        wait was observed."""
+        msgs = self.violations()
+        if msgs:
+            raise WitnessViolation(
+                f"lock witness found {len(msgs)} violation(s):\n"
+                + "\n".join(msgs))
+
+    def report(self) -> str:
+        with self._reg:
+            n_locks, n_edges = len(self._names), len(self._edges)
+        msgs = self.violations()
+        head = (f"lock witness: {n_locks} lock(s), "
+                f"{self.acquisitions} acquisition(s), {n_edges} order "
+                f"edge(s), {len(msgs)} violation(s)")
+        return head + ("\n" + "\n".join(msgs) if msgs else "")
+
+    def reset(self) -> None:
+        with self._reg:
+            self._edges.clear()
+            self._wait_violations.clear()
+            self._acquisitions = 0
+
+
+class WitnessLock:
+    """Drop-in ``threading.Lock`` recording acquisition order."""
+
+    _KIND = "Lock"
+    _REENTRANT = False
+
+    def __init__(self, witness: LockWitness, raw=None):
+        self._witness = witness
+        self._raw = raw if raw is not None else _OrigLock()
+        self._key, self._name = witness._register(self._KIND)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            self._witness._note_acquire(self._key, self._REENTRANT)
+        return ok
+
+    def release(self) -> None:
+        self._raw.release()
+        self._witness._note_release(self._key)
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<{type(self).__name__} {self._name}>"
+
+
+class WitnessRLock(WitnessLock):
+    """Drop-in ``threading.RLock``; re-acquisition by the owning thread
+    adds no order edges (same node)."""
+
+    _KIND = "RLock"
+    _REENTRANT = True
+
+    def __init__(self, witness: LockWitness, raw=None):
+        super().__init__(witness, raw if raw is not None else _OrigRLock())
+
+    def _is_owned(self) -> bool:
+        # threading.Condition probes this on user-supplied locks.
+        return self._raw._is_owned()
+
+
+class WitnessCondition:
+    """Drop-in ``threading.Condition``.  ``wait``/``wait_for`` release
+    the witnessed lock (held-stack updated accordingly) and flag a
+    violation if *other* witnessed locks are still held across the wait.
+    """
+
+    def __init__(self, witness: LockWitness, lock=None):
+        self._witness = witness
+        if lock is None:
+            lock = WitnessRLock(witness)
+        if isinstance(lock, WitnessLock):
+            self._wlock = lock
+            self._cond = _OrigCondition(lock._raw)
+        else:
+            # A raw/pre-install lock: witness can't track it, but waits
+            # are still checked against the locks it does track.
+            self._wlock = None
+            self._cond = _OrigCondition(lock)
+
+    def acquire(self, *args, **kwargs):
+        if self._wlock is not None:
+            return self._wlock.acquire(*args, **kwargs)
+        return self._cond.acquire(*args, **kwargs)
+
+    def release(self) -> None:
+        if self._wlock is not None:
+            self._wlock.release()
+        else:
+            self._cond.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        key = self._wlock._key if self._wlock is not None else None
+        self._witness._note_wait("Condition.wait", key)
+        n = self._witness._drop_for_wait(key) if key is not None else 0
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            if key is not None:
+                self._witness._restore_after_wait(key, n)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # Reimplemented over self.wait so held-stack accounting and the
+        # wait-violation check apply to every underlying wait.
+        import time as _time
+        end = None if timeout is None else _time.monotonic() + timeout
+        result = predicate()
+        while not result:
+            remaining = None
+            if end is not None:
+                remaining = end - _time.monotonic()
+                if remaining <= 0:
+                    break
+            self.wait(remaining)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+
+class WitnessEvent:
+    """Drop-in ``threading.Event``; ``wait`` while holding any witnessed
+    lock is a violation (the setter may need that lock — LC303's runtime
+    mirror).
+
+    Implemented directly over original primitives rather than wrapping
+    ``threading.Event``: while the witness is installed, the stock Event
+    would build its internal condition from the *patched* module globals,
+    double-reporting every wait and registering phantom locks for
+    threading-internal events (``Thread._started``)."""
+
+    def __init__(self, witness: LockWitness):
+        self._witness = witness
+        self._cond = _OrigCondition(_OrigLock())
+        self._flag = False
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        with self._cond:
+            self._flag = True
+            self._cond.notify_all()
+
+    def clear(self) -> None:
+        with self._cond:
+            self._flag = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._witness._note_wait("Event.wait", None)
+        with self._cond:
+            return self._cond.wait_for(lambda: self._flag, timeout)
+
+
+def install_witness(witness: Optional[LockWitness] = None):
+    """Monkeypatch ``threading.Lock``/``RLock``/``Condition``/``Event``
+    so every lock created while installed is witnessed.  Returns
+    ``(witness, uninstall)``; call ``uninstall()`` (idempotent) to
+    restore whatever the factories were before this install.
+
+    Wrappers survive uninstall — locks created under the witness keep
+    reporting to it for their lifetime.
+    """
+    w = witness if witness is not None else LockWitness()
+    prior = (threading.Lock, threading.RLock, threading.Condition,
+             threading.Event)
+
+    def _lock():
+        return WitnessLock(w)
+
+    def _rlock():
+        return WitnessRLock(w)
+
+    def _condition(lock=None):
+        return WitnessCondition(w, lock)
+
+    def _event():
+        return WitnessEvent(w)
+
+    threading.Lock = _lock
+    threading.RLock = _rlock
+    threading.Condition = _condition
+    threading.Event = _event
+
+    done = []
+
+    def uninstall() -> None:
+        if done:
+            return
+        done.append(True)
+        (threading.Lock, threading.RLock, threading.Condition,
+         threading.Event) = prior
+
+    return w, uninstall

@@ -28,8 +28,8 @@ _SRC = os.path.join(_DIR, "decoder.cpp")
 _LIB = os.path.join(_DIR, "libd3dnative.so")
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
+_lib: Optional[ctypes.CDLL] = None  # guarded-by: _lock
+_tried = False  # guarded-by: _lock
 
 _ERRORS = {1: "cannot open file", 2: "not a PNG", 3: "PNG decode error",
            4: "bad arguments"}
@@ -89,7 +89,7 @@ def available() -> bool:
     return _load() is not None
 
 
-_shared_pool: Optional["DecoderPool"] = None
+_shared_pool: Optional["DecoderPool"] = None  # guarded-by: _pool_lock
 
 
 _pool_lock = threading.Lock()

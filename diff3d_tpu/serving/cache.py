@@ -35,12 +35,14 @@ class ParamsRegistry:
 
     def __init__(self, params, version: str = "v0"):
         self._lock = threading.Lock()
-        self._params = params
-        self._version = version
+        self._params = params  # guarded-by: self._lock
+        self._version = version  # guarded-by: self._lock
+        # _template/_treedef are write-once in __init__; swap() only
+        # compares against them, so they need no guard.
         self._template = [(l.shape, l.dtype)
                           for l in jax.tree.leaves(params)]
         self._treedef = jax.tree.structure(params)
-        self.swaps = 0
+        self.swaps = 0  # guarded-by: self._lock
 
     def current(self) -> Tuple[str, Any]:
         with self._lock:
@@ -100,7 +102,7 @@ class ProgramCache:
                  getattr(sampler, "steps", None)): sampler}
             self._sampler = sampler
         self._lock = threading.Lock()
-        self._programs: Dict[tuple, dict] = {}
+        self._programs: Dict[tuple, dict] = {}  # guarded-by: self._lock
         m = metrics
         self._compiles = m.counter(
             "serving_program_compiles_total",
@@ -245,7 +247,8 @@ class ResultCache:
     def __init__(self, capacity: int = 32, metrics=None):
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._entries: "OrderedDict[str, np.ndarray]" = (
+            OrderedDict())  # guarded-by: self._lock
         m = metrics
         self._hit_ctr = m.counter(
             "serving_result_cache_hits_total",

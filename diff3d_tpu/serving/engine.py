@@ -235,15 +235,16 @@ class Engine:
             base_delay_s=cfg.step_retry_backoff_s,
             max_delay_s=max(cfg.step_retry_backoff_s * 8, 1e-9),
             classify=is_transient_backend_error)
-        self._health = HEALTH_OK
+        self._health = HEALTH_OK  # guarded-by: self._health_lock
         self._health_lock = threading.Lock()
-        self._ok_streak = 0          # clean steps since the last fault
+        # Clean steps since the last fault.
+        self._ok_streak = 0  # guarded-by: self._health_lock
         self._restarts = 0
         # Admitted-but-unresolved requests, so the watchdog thread can
         # fail them with typed retryable errors when the loop wedges.
         # ViewRequest._reject is idempotent under the request's own
         # lock, so watchdog and loop racing on the same request is safe.
-        self._inflight: dict = {}
+        self._inflight: dict = {}  # guarded-by: self._inflight_lock
         self._inflight_lock = threading.Lock()
         # Monotonic deadline of the dispatch currently on device (None
         # when no dispatch is running); read by the watchdog.
@@ -365,14 +366,15 @@ class Engine:
 
     @property
     def health(self) -> str:
-        return self._health
+        with self._health_lock:
+            return self._health
 
     def snapshot_extra(self) -> dict:
         """Engine-level details merged into the metrics snapshot."""
         return {
             "engine": {
                 "alive": self.alive,
-                "health": self._health,
+                "health": self.health,
                 "restarts": self._restarts,
                 "params_version": self.registry.version,
                 "lane_multiple": self.lane_multiple,
@@ -402,7 +404,9 @@ class Engine:
         """Batch ceiling under the current health: degraded mode halves
         it (rounded up to the mesh quantum) to cut blast radius while
         the fault source is live."""
-        if self._health != HEALTH_DEGRADED:
+        with self._health_lock:
+            degraded = self._health == HEALTH_DEGRADED
+        if not degraded:
             return self.max_batch
         half = max(1, self.max_batch // 2)
         half = -(-half // self.lane_multiple) * self.lane_multiple

@@ -30,7 +30,7 @@ class Counter:
     def __init__(self, name: str, help_: str = ""):
         self.name, self.help = name, help_
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: self._lock
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
@@ -48,7 +48,7 @@ class Gauge:
     def __init__(self, name: str, help_: str = ""):
         self.name, self.help = name, help_
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: self._lock
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -75,9 +75,10 @@ class Histogram:
     def __init__(self, name: str, help_: str = "", window: int = 1024):
         self.name, self.help = name, help_
         self._lock = threading.Lock()
-        self._window: Deque[float] = deque(maxlen=window)
-        self._count = 0
-        self._sum = 0.0
+        self._window: Deque[float] = (
+            deque(maxlen=window))  # guarded-by: self._lock
+        self._count = 0  # guarded-by: self._lock
+        self._sum = 0.0  # guarded-by: self._lock
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -106,9 +107,10 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: Dict[str, Counter] = {}
-        self._gauges: Dict[str, Gauge] = {}
-        self._histograms: Dict[str, Histogram] = {}
+        self._counters: Dict[str, Counter] = {}  # guarded-by: self._lock
+        self._gauges: Dict[str, Gauge] = {}  # guarded-by: self._lock
+        self._histograms: Dict[str, Histogram] = (
+            {})  # guarded-by: self._lock
 
     def counter(self, name: str, help_: str = "") -> Counter:
         with self._lock:

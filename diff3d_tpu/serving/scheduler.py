@@ -181,9 +181,9 @@ class ViewRequest:
 
         self._event = threading.Event()
         self._lock = threading.Lock()
-        self._result: Optional[np.ndarray] = None
-        self._error: Optional[BaseException] = None
-        self._cancelled = False
+        self._result: Optional[np.ndarray] = None  # guarded-by: self._lock
+        self._error: Optional[BaseException] = None  # guarded-by: self._lock
+        self._cancelled = False  # guarded-by: self._lock
 
     # -- result plumbing ------------------------------------------------
 
@@ -192,7 +192,10 @@ class ViewRequest:
 
     @property
     def error(self) -> Optional[BaseException]:
-        return self._error
+        # Read-after-done: _resolve/_reject write under _lock and then
+        # Event.set; callers look only after done(), so the Event
+        # publish gives the happens-before the lock normally would.
+        return self._error  # lockcheck: disable=LC302(happens-before via _event.set)
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block for the result ``[n_views-1, B, H, W, 3]``; raises the
@@ -200,9 +203,12 @@ class ViewRequest:
         if not self._event.wait(timeout):
             raise RequestTimeout(
                 f"{self.id}: no result within {timeout}s")
-        if self._error is not None:
-            raise self._error
-        return self._result
+        # Event.wait returned True, so the writes in _resolve/_reject
+        # happen-before these reads — no lock needed.
+        err = self._error  # lockcheck: disable=LC302(happens-before via _event.wait)
+        if err is not None:
+            raise err
+        return self._result  # lockcheck: disable=LC302(happens-before via _event.wait)
 
     def _resolve(self, result: np.ndarray) -> None:
         with self._lock:
@@ -232,7 +238,9 @@ class ViewRequest:
 
     @property
     def cancelled(self) -> bool:
-        return self._cancelled
+        # Monotonic flag: a stale False only delays the drop to the
+        # scheduler's next sweep.
+        return self._cancelled  # lockcheck: disable=LC302(racy read of monotonic flag is benign)
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -278,16 +286,18 @@ class Scheduler:
         self.default_timeout_s = default_timeout_s
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
-        self._pending: "OrderedDict[Bucket, Deque[ViewRequest]]" = \
-            OrderedDict()
-        self._closed = False
+        self._pending: "OrderedDict[Bucket, Deque[ViewRequest]]" = (
+            OrderedDict())  # guarded-by: self._lock
+        self._closed = False  # guarded-by: self._lock
         # Fault-tolerance admission policy (set by the engine): when
         # frozen, every submission is rejected with the factory's typed
         # error (drain mode / dead engine); a soft limit rejects
         # submissions beyond a reduced depth while degraded.
-        self._frozen: Optional[Callable[[], BaseException]] = None
-        self._soft_limit: Optional[int] = None
-        self._soft_exc: Optional[Callable[[], BaseException]] = None
+        self._frozen: Optional[Callable[[], BaseException]] = (
+            None)  # guarded-by: self._lock
+        self._soft_limit: Optional[int] = None  # guarded-by: self._lock
+        self._soft_exc: Optional[Callable[[], BaseException]] = (
+            None)  # guarded-by: self._lock
         m = metrics
         self._depth_gauge = m.gauge(
             "serving_queue_depth",
@@ -306,33 +316,41 @@ class Scheduler:
     # -- producer side --------------------------------------------------
 
     def submit(self, req: ViewRequest) -> ViewRequest:
+        # Admission decisions happen under the lock; the rejection
+        # *callbacks* run after it is released — an exc_factory that
+        # re-enters the scheduler (depth(), another submit) must not
+        # find this thread still holding _lock (LC306).
+        reject: Optional[Callable[[], BaseException]] = None
         with self._lock:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
             if self._frozen is not None:
                 if self._rejects:
                     self._rejects.inc()
-                raise self._frozen()
-            if (self._soft_limit is not None
+                reject = self._frozen
+            elif (self._soft_limit is not None
                     and self._depth_locked() >= self._soft_limit):
                 if self._rejects:
                     self._rejects.inc()
-                raise (self._soft_exc() if self._soft_exc is not None
-                       else EngineOverloaded(
-                           "replica degraded: queue soft limit reached"))
-            if self._depth_locked() >= self.max_queue:
+                reject = self._soft_exc if self._soft_exc is not None \
+                    else lambda: EngineOverloaded(
+                        "replica degraded: queue soft limit reached")
+            elif self._depth_locked() >= self.max_queue:
                 if self._rejects:
                     self._rejects.inc()
                 raise QueueFullError(
                     f"queue full ({self.max_queue} pending): retry later")
-            now = time.monotonic()
-            req.submit_time = now
-            timeout = (self.default_timeout_s if req.timeout_s is None
-                       else req.timeout_s)
-            req.deadline = now + timeout
-            self._pending.setdefault(req.bucket, deque()).append(req)
-            self._update_depth()
-            self._nonempty.notify_all()
+            else:
+                now = time.monotonic()
+                req.submit_time = now
+                timeout = (self.default_timeout_s if req.timeout_s is None
+                           else req.timeout_s)
+                req.deadline = now + timeout
+                self._pending.setdefault(req.bucket, deque()).append(req)
+                self._update_depth()
+                self._nonempty.notify_all()
+        if reject is not None:
+            raise reject()
         return req
 
     # -- consumer (engine) side -----------------------------------------
@@ -438,19 +456,22 @@ class Scheduler:
         typed retryable error, so clients know to go elsewhere.  Returns
         the number shed.
         """
-        n = 0
+        victims: List[ViewRequest] = []
         with self._lock:
             keep = self._oldest_bucket_locked() if keep_oldest else None
             for b in list(self._pending):
                 if b == keep:
                     continue
-                for req in self._pending.pop(b):
-                    req._reject(exc_factory(req))
-                    n += 1
-                    if self._shed:
-                        self._shed.inc()
+                victims.extend(self._pending.pop(b))
             self._update_depth()
-        return n
+        # Resolve outside the lock: exc_factory is caller code (LC306),
+        # and _reject takes each request's own lock — no reason to hold
+        # the scheduler lock across either.
+        for req in victims:
+            req._reject(exc_factory(req))
+            if self._shed:
+                self._shed.inc()
+        return len(victims)
 
     def close(self, reject_pending: bool = True) -> None:
         """Stop accepting work; optionally reject everything queued."""
@@ -467,14 +488,14 @@ class Scheduler:
 
     # -- internals (lock held) ------------------------------------------
 
-    def _depth_locked(self) -> int:
+    def _depth_locked(self) -> int:  # guarded-by: self._lock
         return sum(len(q) for q in self._pending.values())
 
-    def _update_depth(self) -> None:
+    def _update_depth(self) -> None:  # guarded-by: self._lock
         if self._depth_gauge:
             self._depth_gauge.set(self._depth_locked())
 
-    def _sweep_locked(self) -> None:
+    def _sweep_locked(self) -> None:  # guarded-by: self._lock
         """Resolve expired / drop cancelled requests in place."""
         now = time.monotonic()
         for b in list(self._pending):
@@ -496,14 +517,14 @@ class Scheduler:
             else:
                 del self._pending[b]
 
-    def _oldest_bucket_locked(self) -> Optional[Bucket]:
+    def _oldest_bucket_locked(self) -> Optional[Bucket]:  # guarded-by: self._lock
         best, best_t = None, None
         for b, q in self._pending.items():
             if q and (best_t is None or q[0].submit_time < best_t):
                 best, best_t = b, q[0].submit_time
         return best
 
-    def _take_locked(self, bucket: Optional[Bucket],
+    def _take_locked(self, bucket: Optional[Bucket],  # guarded-by: self._lock
                      max_n: int) -> List[ViewRequest]:
         if bucket is None or bucket not in self._pending or max_n <= 0:
             return []

@@ -148,8 +148,8 @@ class CheckpointManager:
         self._write_retry = write_retry or _DEFAULT_WRITE_RETRY
         self._async = bool(async_writes) and self.mode == "full_sliced"
         self._async_lock = threading.Lock()
-        self._async_error: BaseException | None = None
-        self._pending_steps: set[int] = set()
+        self._async_error: BaseException | None = None  # guarded-by: self._async_lock
+        self._pending_steps: set[int] = set()  # guarded-by: self._async_lock
         self._queue: queue.Queue = queue.Queue()
         self._inflight_sem = threading.Semaphore(max(1, max_inflight_saves))
         self._writer: threading.Thread | None = None

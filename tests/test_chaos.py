@@ -154,11 +154,17 @@ def test_persistent_faults_degrade_then_recover(chaos_env):
         svc.stop()
 
 
-def test_watchdog_rejects_stuck_step(chaos_env):
+@pytest.mark.lock_witness
+def test_watchdog_rejects_stuck_step(chaos_env, lock_witness):
     """A wedged dispatch (injected 1.5s stall vs a 0.3s watchdog): the
     in-flight requests fail fast with a typed retryable error instead of
     hanging, the trip is counted once, and queued work in other buckets
-    still completes after recovery."""
+    still completes after recovery.
+
+    Runs under the lock witness: the whole engine/scheduler/watchdog
+    stack is built inside the test body, so every lock it creates is
+    order-checked and any held-lock wait on the trip/recovery path
+    fails the test."""
     cfg, sampler, ds = chaos_env
     inj = FaultInjector(seed=0)
     inj.add("engine.step", at_calls=(1,), kind="slow", delay_s=1.5)
@@ -220,10 +226,15 @@ def test_drain_mode_blocks_admission_and_finishes_inflight(chaos_env):
         svc.stop()
 
 
-def test_stop_timeout_reports_leaked_worker(chaos_env):
+@pytest.mark.lock_witness
+def test_stop_timeout_reports_leaked_worker(chaos_env, lock_witness):
     """stop(timeout) on a wedged worker: raises EngineStopTimeout, bumps
     the leak counter, and resolves in-flight futures with EngineStopped —
-    never a silent return with a live thread and hung clients."""
+    never a silent return with a live thread and hung clients.
+
+    Runs under the lock witness: stop() races the wedged worker's
+    drain, exactly where an inverted lock order or a wait under the
+    engine lock would deadlock a real shutdown."""
     cfg, sampler, ds = chaos_env
     inj = FaultInjector(seed=0)
     inj.add("engine.step", at_calls=(1,), kind="slow", delay_s=2.5)

@@ -1,0 +1,811 @@
+"""lockcheck (static) and the lock witness (runtime), tested from both
+sides.
+
+For every LC rule (LC301–LC308) there is a known-BAD fixture that must
+fire and a known-GOOD fixture that must stay silent — the silent side
+encodes the concurrency idioms this repo actually uses (condition waits
+in while-predicate loops, capture-under-lock / invoke-after-release,
+``_locked``-suffix methods with def-line ``# guarded-by:``
+preconditions).  Then the suppression grammar (lockcheck's namespace is
+independent of graftlint's), the baseline round-trip, the runtime
+witness against a seeded lock-order inversion and a held-lock wait, the
+``lock_witness`` pytest marker end-to-end (including its vacuous-pass
+protection), and the tier-1 gates: the threaded modules and the whole
+repo must lockcheck clean.
+"""
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from diff3d_tpu.analysis.lint import (DEFAULT_TARGETS, apply_baseline,
+                                      load_baseline, write_baseline)
+from diff3d_tpu.analysis.lockcheck import (lockcheck_paths,
+                                           lockcheck_source)
+from diff3d_tpu.analysis.witness import (LockWitness, WitnessViolation,
+                                         install_witness)
+
+pytest_plugins = ("pytester",)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The threaded modules the LC pass is aimed at; pinned individually so
+#: a regression names the file, not just "the repo".
+_THREADED_MODULES = (
+    "diff3d_tpu/serving/engine.py",
+    "diff3d_tpu/serving/scheduler.py",
+    "diff3d_tpu/serving/cache.py",
+    "diff3d_tpu/serving/metrics.py",
+    "diff3d_tpu/train/checkpoint.py",
+    "diff3d_tpu/data/loader.py",
+    "diff3d_tpu/native/__init__.py",
+)
+
+
+def _findings(src, rule=None):
+    out = lockcheck_source("<fixture>.py", textwrap.dedent(src))
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+def _live(src, rule=None):
+    return [f for f in _findings(src, rule) if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# LC001 / LC002: parse failures and reasonless suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_lc001_syntax_error_is_a_finding():
+    (f,) = _live("def f(:\n", "LC001")
+    assert f.severity == "error" and "parse" in f.message
+
+
+def test_lc002_suppression_without_reason():
+    src = """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    time.sleep(1.0)  # lockcheck: disable=LC303
+    """
+    assert not _live(src, "LC303")          # the suppression still works
+    (f,) = _live(src, "LC002")
+    assert "no (reason)" in f.message
+
+
+# ---------------------------------------------------------------------------
+# LC301: lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+def test_lc301_fires_on_inverted_order():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def f(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def g(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    (f,) = _live(src, "LC301")
+    assert "lock-order cycle" in f.message and "self._a" in f.message
+
+
+def test_lc301_sees_order_through_self_calls():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def f(self):
+                with self._a:
+                    self._grab_b()
+
+            def _grab_b(self):
+                with self._b:
+                    pass
+
+            def g(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    assert _live(src, "LC301")
+
+
+def test_lc301_silent_on_consistent_order():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def f(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def g(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """
+    assert not _live(src, "LC301")
+
+
+# ---------------------------------------------------------------------------
+# LC302: guarded-by discipline
+# ---------------------------------------------------------------------------
+
+
+def test_lc302_fires_on_unguarded_access():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0  # guarded-by: self._lock
+
+            def bump(self):
+                self._count += 1
+    """
+    (f,) = _live(src, "LC302")
+    assert "self._count" in f.message and "written" in f.message
+
+
+def test_lc302_silent_under_lock_and_in_init():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0  # guarded-by: self._lock
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+
+            def snapshot(self):
+                with self._lock:
+                    return self._count
+    """
+    assert not _live(src, "LC302")
+
+
+def test_lc302_def_line_precondition_counts_as_held():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: self._lock
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):  # guarded-by: self._lock
+                self._n += 1
+    """
+    assert not _live(src, "LC302")
+
+
+def test_lc302_warns_on_unknown_guard():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: self._mutex
+    """
+    (f,) = _live(src, "LC302")
+    assert f.severity == "warning" and "self._mutex" in f.message
+
+
+def test_lc302_module_global_guard():
+    src = """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = None  # guarded-by: _lock
+
+        def get():
+            return _cache
+
+        def get_locked():
+            with _lock:
+                return _cache
+    """
+    (f,) = _live(src, "LC302")
+    assert "_cache" in f.message and "read" in f.message
+
+
+# ---------------------------------------------------------------------------
+# LC303: blocking under a lock
+# ---------------------------------------------------------------------------
+
+
+def test_lc303_fires_on_sleep_and_event_wait_under_lock():
+    src = """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = threading.Event()
+
+            def f(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            def g(self):
+                with self._lock:
+                    self._done.wait()
+    """
+    live = _live(src, "LC303")
+    assert len(live) == 2
+    assert any("time.sleep" in f.message for f in live)
+    assert any("Event.wait" in f.message for f in live)
+
+
+def test_lc303_silent_outside_lock_and_on_bounded_queue():
+    src = """
+        import queue
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def f(self):
+                time.sleep(0.5)
+                with self._lock:
+                    item = self._q.get(timeout=1.0)
+                    self._q.put(item, block=False)
+                return item
+    """
+    assert not _live(src, "LC303")
+
+
+def test_lc303_fires_on_condition_wait_holding_other_lock():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv_lock = threading.Lock()
+                self._cv = threading.Condition(self._cv_lock)
+                self._ready = False
+
+            def f(self):
+                with self._lock:
+                    with self._cv:
+                        while not self._ready:
+                            self._cv.wait()
+    """
+    live = _live(src, "LC303")
+    assert live and "Condition.wait" in live[0].message
+
+
+# ---------------------------------------------------------------------------
+# LC304: Condition.wait without a predicate loop
+# ---------------------------------------------------------------------------
+
+
+def test_lc304_fires_on_bare_wait():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+
+            def f(self):
+                with self._cv:
+                    self._cv.wait()
+    """
+    (f,) = _live(src, "LC304")
+    assert "while-predicate" in f.message
+
+
+def test_lc304_silent_in_while_loop():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._ready = False
+
+            def f(self):
+                with self._cv:
+                    while not self._ready:
+                        self._cv.wait()
+    """
+    assert not _live(src, "LC304")
+
+
+# ---------------------------------------------------------------------------
+# LC305: thread leaks
+# ---------------------------------------------------------------------------
+
+
+def test_lc305_fires_on_unjoined_nondaemon_thread():
+    src = """
+        import threading
+
+        def work():
+            pass
+
+        def start():
+            t = threading.Thread(target=work)
+            t.start()
+            return t
+    """
+    (f,) = _live(src, "LC305")
+    assert f.severity == "warning" and "daemon" in f.message
+
+
+def test_lc305_silent_on_daemon_or_joined():
+    src = """
+        import threading
+
+        class C:
+            def work(self):
+                pass
+
+            def start(self):
+                self._t = threading.Thread(target=self.work)
+                self._t.start()
+                threading.Thread(target=self.work, daemon=True).start()
+
+            def stop(self):
+                self._t.join()
+    """
+    assert not _live(src, "LC305")
+
+
+# ---------------------------------------------------------------------------
+# LC306: callbacks invoked under the lock
+# ---------------------------------------------------------------------------
+
+
+def test_lc306_fires_on_callback_attr_under_lock():
+    src = """
+        import threading
+        from typing import Callable, Optional
+
+        class C:
+            def __init__(self, on_done: Callable[[], None]):
+                self._lock = threading.Lock()
+                self._on_done = on_done
+
+            def finish(self):
+                with self._lock:
+                    self._on_done()
+    """
+    (f,) = _live(src, "LC306")
+    assert "self._on_done" in f.message and "after release" in f.message
+
+
+def test_lc306_fires_on_callback_param_under_lock():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def each(self, callback):
+                with self._lock:
+                    callback()
+    """
+    assert _live(src, "LC306")
+
+
+def test_lc306_silent_on_capture_then_invoke():
+    src = """
+        import threading
+        from typing import Callable
+
+        class C:
+            def __init__(self, on_done: Callable[[], None]):
+                self._lock = threading.Lock()
+                self._on_done = on_done
+
+            def finish(self):
+                with self._lock:
+                    cb = self._on_done
+                cb()
+    """
+    assert not _live(src, "LC306")
+
+
+# ---------------------------------------------------------------------------
+# LC307: double acquire of a non-reentrant Lock
+# ---------------------------------------------------------------------------
+
+
+def test_lc307_fires_on_nested_acquire():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """
+    (f,) = _live(src, "LC307")
+    assert "not reentrant" in f.message
+
+
+def test_lc307_fires_through_self_call():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """
+    assert any("may re-acquire" in f.message
+               for f in _live(src, "LC307"))
+
+
+def test_lc307_silent_on_rlock():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+                    with self._lock:
+                        pass
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """
+    assert not _live(src, "LC307")
+
+
+# ---------------------------------------------------------------------------
+# LC308: unguarded global mutation from a thread target
+# ---------------------------------------------------------------------------
+
+
+def test_lc308_fires_on_bare_global_write_from_thread_target():
+    src = """
+        import threading
+
+        _stats = {}
+
+        def worker():
+            _stats["n"] = 1
+
+        def start():
+            threading.Thread(target=worker, daemon=True).start()
+    """
+    (f,) = _live(src, "LC308")
+    assert "_stats" in f.message
+
+
+def test_lc308_silent_when_locked_or_not_a_thread_target():
+    src = """
+        import threading
+
+        _lock = threading.Lock()
+        _stats = {}
+        _other = {}
+
+        def worker():
+            with _lock:
+                _stats["n"] = 1
+
+        def not_a_target():
+            _other["n"] = 1
+
+        def start():
+            threading.Thread(target=worker, daemon=True).start()
+    """
+    assert not _live(src, "LC308")
+
+
+# ---------------------------------------------------------------------------
+# Suppression namespace + baseline round-trip
+# ---------------------------------------------------------------------------
+
+_SLEEPY = """
+    import threading
+    import time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def f(self):
+            with self._lock:
+                time.sleep(1.0){comment}
+"""
+
+
+def test_suppression_with_reason_is_clean():
+    src = _SLEEPY.format(
+        comment="  # lockcheck: disable=LC303(bench-only; lock uncontended)")
+    assert not _live(src)
+    supp = [f for f in _findings(src, "LC303") if f.suppressed]
+    assert len(supp) == 1
+
+
+def test_graftlint_suppression_does_not_reach_lockcheck():
+    src = _SLEEPY.format(comment="  # graftlint: disable=LC303(wrong tool)")
+    assert _live(src, "LC303")
+
+
+def test_baseline_round_trip(tmp_path):
+    src = _SLEEPY.format(comment="")
+    path = tmp_path / "mod.py"
+    path.write_text(textwrap.dedent(src))
+    findings = lockcheck_paths([str(path)])
+    assert [f for f in findings if not f.suppressed]
+
+    baseline = tmp_path / "baseline.json"
+    n = write_baseline(str(baseline), findings, str(tmp_path),
+                       tool="lockcheck")
+    assert n == 1
+    rebased = apply_baseline(lockcheck_paths([str(path)]),
+                             load_baseline(str(baseline)), str(tmp_path))
+    assert not [f for f in rebased if not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# The runtime witness
+# ---------------------------------------------------------------------------
+
+
+def test_witness_catches_seeded_lock_inversion():
+    witness, uninstall = install_witness()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        # Strictly sequenced — the witness flags the *order*, so no
+        # interleaving (and no real deadlock) is needed.
+        t1 = threading.Thread(target=ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start()
+        t2.join()
+    finally:
+        uninstall()
+    cycles = witness.cycles()
+    assert len(cycles) == 1 and len(set(cycles[0])) == 2
+    with pytest.raises(WitnessViolation, match="lock-order cycle"):
+        witness.check()
+
+
+def test_witness_catches_held_lock_event_wait():
+    witness, uninstall = install_witness()
+    try:
+        lock = threading.Lock()
+        ev = threading.Event()
+        ev.set()
+        with lock:
+            assert ev.wait(0.1)
+    finally:
+        uninstall()
+    assert witness.wait_violations
+    assert "Event.wait" in witness.wait_violations[0]
+    with pytest.raises(WitnessViolation, match="held-lock wait"):
+        witness.check()
+
+
+def test_witness_clean_on_consistent_order_and_reset():
+    witness, uninstall = install_witness()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with a:
+            with b:
+                pass
+        cv = threading.Condition()
+        done = []
+
+        def setter():
+            with cv:
+                done.append(1)
+                cv.notify_all()
+
+        t = threading.Thread(target=setter)
+        t.start()
+        with cv:
+            while not done:
+                cv.wait(1.0)
+        t.join()
+    finally:
+        uninstall()
+    assert witness.acquisitions >= 4
+    witness.check()                     # no cycles, no bad waits
+    witness.reset()
+    assert witness.acquisitions == 0 and not witness.cycles()
+
+
+def test_witness_rlock_reacquire_is_not_a_cycle():
+    witness, uninstall = install_witness()
+    try:
+        r = threading.RLock()
+        with r:
+            with r:
+                pass
+    finally:
+        uninstall()
+    witness.check()
+
+
+def test_install_witness_restores_factories():
+    orig = (threading.Lock, threading.RLock, threading.Condition,
+            threading.Event)
+    witness, uninstall = install_witness()
+    assert threading.Lock is not orig[0]
+    uninstall()
+    uninstall()                         # idempotent
+    assert (threading.Lock, threading.RLock, threading.Condition,
+            threading.Event) == orig
+    assert isinstance(witness, LockWitness)
+
+
+# ---------------------------------------------------------------------------
+# The lock_witness pytest marker, end to end
+# ---------------------------------------------------------------------------
+
+_INNER_PREAMBLE = "import threading\nimport pytest\n"
+
+
+def _run_inner(pytester, body):
+    pytester.makepyfile(_INNER_PREAMBLE + textwrap.dedent(body))
+    return pytester.runpytest_inprocess(
+        "-p", "diff3d_tpu.analysis.pytest_plugin",
+        "-p", "no:cacheprovider")
+
+
+def test_marker_passes_on_clean_locking(pytester):
+    result = _run_inner(pytester, """
+        @pytest.mark.lock_witness
+        def test_clean(lock_witness):
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+    """)
+    result.assert_outcomes(passed=1)
+
+
+def test_marker_fails_on_seeded_inversion(pytester):
+    result = _run_inner(pytester, """
+        @pytest.mark.lock_witness
+        def test_inverted(lock_witness):
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+    """)
+    assert result.ret != 0
+    result.stdout.fnmatch_lines(["*lock-order cycle*"])
+
+
+def test_marker_rejects_vacuous_pass(pytester):
+    result = _run_inner(pytester, """
+        @pytest.mark.lock_witness
+        def test_nothing(lock_witness):
+            pass
+    """)
+    assert result.ret != 0
+    result.stdout.fnmatch_lines(["*vacuous*"])
+
+
+def test_marker_requires_fixture(pytester):
+    result = _run_inner(pytester, """
+        @pytest.mark.lock_witness
+        def test_forgot_fixture():
+            pass
+    """)
+    assert result.ret != 0
+    result.stdout.fnmatch_lines(["*requires the*lock_witness fixture*"])
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 gates: the threaded modules and the whole repo are clean
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rel", _THREADED_MODULES)
+def test_threaded_module_lockchecks_clean(rel):
+    """Regression pin for the audited runtime modules: any new blocking
+    call under a lock, unguarded access to annotated state, or callback
+    under a scheduler/engine lock fails here with the file named."""
+    path = os.path.join(_REPO_ROOT, rel)
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    live = [f for f in lockcheck_source(path, src) if not f.suppressed]
+    assert not live, f"unsuppressed lockcheck findings in {rel}:\n" + \
+        "\n".join(f.render() for f in live)
+
+
+def test_repo_lockchecks_clean():
+    """The same invariant ``python tools/lint.py`` gates in CI, pinned
+    here so plain ``pytest`` enforces it too."""
+    targets = [os.path.join(_REPO_ROOT, t) for t in DEFAULT_TARGETS]
+    targets = [t for t in targets if os.path.exists(t)]
+    assert targets, "lockcheck targets missing from the checkout"
+    live = [f for f in lockcheck_paths(targets) if not f.suppressed]
+    assert not live, "unsuppressed lockcheck findings:\n" + "\n".join(
+        f.render() for f in live)

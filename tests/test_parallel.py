@@ -57,7 +57,7 @@ def test_fsdp_state_placement_reduces_per_device_bytes():
 def test_psum_over_mesh_matches_sum():
     """XLA collectives over the mesh = the DDP all-reduce the reference
     delegates to gloo (train.py:230-233)."""
-    from jax import shard_map
+    from diff3d_tpu.parallel import shard_map
 
     env = make_mesh()
     x = jnp.arange(8.0)

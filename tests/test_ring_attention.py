@@ -6,9 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
-
-from diff3d_tpu.parallel import ring_sdpa, ulysses_sdpa
+from diff3d_tpu.parallel import ring_sdpa, shard_map, ulysses_sdpa
 
 
 def _mesh(n):

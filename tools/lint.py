@@ -1,7 +1,7 @@
 """Repo static-analysis gate, runnable as a plain script:
 ``python tools/lint.py``.
 
-Runs BOTH passes as one gate (nonzero exit if either finds anything
+Runs ALL THREE passes as one gate (nonzero exit if any finds anything
 unsuppressed):
 
   * **graftlint** — the AST pass (rules GL1xx, docs/DESIGN.md §9);
@@ -9,12 +9,17 @@ unsuppressed):
     SC2xx, docs/DESIGN.md §10): lowers the mesh-sharded train step and
     sampler ``step_many`` on 8 virtual CPU devices and diffs their
     collectives/dtypes/param placement against the committed manifests
-    under ``runs/shardcheck/``.
+    under ``runs/shardcheck/``;
+  * **lockcheck** — the concurrency pass (rules LC3xx, docs/DESIGN.md
+    §12): lock-order graphs, ``# guarded-by:`` discipline and
+    blocking-under-lock checks over the threaded serving/checkpoint
+    runtime.
 
-``--ast-only`` / ``--ir-only`` select one pass; all other arguments
-pass through to the selected pass(es) — with both passes active only
-argument-free invocation is supported (pass-specific flags differ).
-Works from a checkout without installing the package.
+``--ast-only`` / ``--ir-only`` / ``--lock-only`` select one pass; all
+other arguments pass through to the selected pass — with multiple
+passes active only argument-free invocation is supported
+(pass-specific flags differ).  Works from a checkout without
+installing the package.
 """
 
 from __future__ import annotations
@@ -22,33 +27,38 @@ from __future__ import annotations
 import os
 import sys
 
+_ONLY_FLAGS = ("--ast-only", "--ir-only", "--lock-only")
+
 
 def main() -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo_root not in sys.path:
         sys.path.insert(0, repo_root)
     argv = sys.argv[1:]
-    ast_only = "--ast-only" in argv
-    ir_only = "--ir-only" in argv
-    argv = [a for a in argv if a not in ("--ast-only", "--ir-only")]
-    if ast_only and ir_only:
-        print("tools/lint.py: --ast-only and --ir-only are exclusive",
+    only = [f for f in _ONLY_FLAGS if f in argv]
+    argv = [a for a in argv if a not in _ONLY_FLAGS]
+    if len(only) > 1:
+        print(f"tools/lint.py: {' and '.join(only)} are exclusive",
               file=sys.stderr)
         return 2
-    if argv and not (ast_only or ir_only):
-        print("tools/lint.py: pass-through arguments need --ast-only or "
-              "--ir-only (the two passes take different flags)",
-              file=sys.stderr)
+    selected = only[0] if only else None
+    if argv and selected is None:
+        print("tools/lint.py: pass-through arguments need one of "
+              f"{', '.join(_ONLY_FLAGS)} (the passes take different "
+              "flags)", file=sys.stderr)
         return 2
 
     rc = 0
-    if not ir_only:
+    if selected in (None, "--ast-only"):
         from diff3d_tpu.analysis.lint import main as lint_main
-        rc = max(rc, lint_main(argv if ast_only else []))
-    if not ast_only:
+        rc = max(rc, lint_main(argv if selected else []))
+    if selected in (None, "--lock-only"):
+        from diff3d_tpu.analysis.lockcheck import main as lockcheck_main
+        rc = max(rc, lockcheck_main(argv if selected else []))
+    if selected in (None, "--ir-only"):
         from diff3d_tpu.analysis.shardcheck import main as shardcheck_main
         rc = max(rc, shardcheck_main(
-            argv if ir_only else ["--programs-tier1"]))
+            argv if selected else ["--programs-tier1"]))
     return rc
 
 

@@ -1,0 +1,26 @@
+"""Concurrency static-analysis gate, runnable as a plain script:
+``python tools/lockcheck.py [paths ...]``.
+
+Thin wrapper over ``diff3d_tpu.analysis.lockcheck`` (also installed as
+the ``lockcheck`` console script) so the gate works from a checkout
+without installing the package.  All arguments pass through — see
+``--help`` for the rule list and baseline workflow, and
+docs/DESIGN.md §12 for policy.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from diff3d_tpu.analysis.lockcheck import main as lockcheck_main
+    return lockcheck_main(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
