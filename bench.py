@@ -157,6 +157,13 @@ def _run(global_batch: int, n_steps: int, accum: int = 1,
             f"train_step_{config}",
             step_fn.lower(abstract[0], abstract[1], rng))
         stats["comms"] = ir_lib.comms_summary(report)
+        # memcheck memory block from the SAME lower+compile pass: peak
+        # HBM, donation effectiveness, hoistable scan-invariant FLOPs
+        # (docs/DESIGN.md §13).
+        from diff3d_tpu.analysis import mem as mem_lib
+
+        stats["mem"] = (mem_lib.memory_summary(report.memory)
+                        if report.memory is not None else None)
     except Exception as e:
         stats["comms"] = {"error": str(e).splitlines()[0][:200]}
     return median, stats
@@ -206,7 +213,8 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
                    object_batch: int = 1, use_mesh: bool = False,
                    sampler_kind: str = "ancestral",
                    steps: int | None = None,
-                   comms_out: dict | None = None):
+                   comms_out: dict | None = None,
+                   mem_out: dict | None = None):
     """Seconds per synthesised view, reference sampler config (256 steps,
     8-weight guidance sweep, ``/root/reference/sampling.py:130-158``) —
     one compiled lax.scan per view.  ``srn128`` runs the full-resolution
@@ -233,7 +241,10 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     bytes / upcasts — ``analysis/ir.py``), so the recorded JSON carries
     comms next to the perf number.  Best-effort: on failure (e.g. the
     chunked srn128 path has no single program to lower) the dict gets
-    an ``error`` note instead.
+    an ``error`` note instead.  ``mem_out`` is the same contract for the
+    memcheck memory summary (peak HBM / donation table / hoistable
+    scan-invariant FLOPs — ``analysis/mem.py``), extracted from the
+    same lower+compile pass.
     """
     import jax
     import numpy as np
@@ -258,18 +269,25 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
                       scan_chunks=chunks, mesh=mesh_env,
                       sampler_kind=sampler_kind, steps=steps)
 
-    if comms_out is not None:
+    if comms_out is not None or mem_out is not None:
         try:
             from diff3d_tpu.analysis import ir as ir_lib
+            from diff3d_tpu.analysis import mem as mem_lib
             from diff3d_tpu.sampling.runtime import record_capacity
 
             lanes = max(object_batch, sampler.lane_multiple)
             lowered = sampler.lower_step_many(
                 lanes, record_capacity(n_views))
-            comms_out.update(ir_lib.comms_summary(ir_lib.analyze_lowered(
-                f"step_many_{config}", lowered)))
+            report = ir_lib.analyze_lowered(
+                f"step_many_{config}", lowered)
+            if comms_out is not None:
+                comms_out.update(ir_lib.comms_summary(report))
+            if mem_out is not None and report.memory is not None:
+                mem_out.update(mem_lib.memory_summary(report.memory))
         except Exception as e:
-            comms_out["error"] = str(e).splitlines()[0][:200]
+            for d in (comms_out, mem_out):
+                if d is not None:
+                    d["error"] = str(e).splitlines()[0][:200]
 
     s = cfg.model.H
 
@@ -489,7 +507,9 @@ def main() -> int:
             payload["srn128"] = {"error": str(e).splitlines()[0][:200]}
         try:
             comms: dict = {}
-            sec_per_view, raw_s, n_eff = _sampler_bench(comms_out=comms)
+            mem: dict = {}
+            sec_per_view, raw_s, n_eff = _sampler_bench(
+                comms_out=comms, mem_out=mem)
             payload["sampler"] = {
                 "metric": f"sampler_sec_per_view_srn64_{platform}",
                 "value": round(sec_per_view, 2),
@@ -499,6 +519,7 @@ def main() -> int:
                 "effective_views": n_eff,
                 "chips_used": 1,
                 "comms": comms,
+                "mem": mem,
             }
         except Exception as e:
             payload["sampler"] = {"error": str(e).splitlines()[0][:200]}
@@ -509,9 +530,10 @@ def main() -> int:
             # per-chip scaling = value / sharded.sec_per_view.
             try:
                 sh_comms: dict = {}
+                sh_mem: dict = {}
                 sh_spv, sh_raw, sh_eff = _sampler_bench(
                     object_batch=ndev, use_mesh=True,
-                    comms_out=sh_comms)
+                    comms_out=sh_comms, mem_out=sh_mem)
                 payload["sampler"]["sharded"] = {
                     "chips_used": ndev,
                     "sec_per_view": round(sh_spv, 2),
@@ -522,6 +544,7 @@ def main() -> int:
                         payload["sampler"]["value"] / sh_spv, 2)
                     if sh_spv else None,
                     "comms": sh_comms,
+                    "mem": sh_mem,
                 }
             except Exception as e:
                 payload["sampler"]["sharded"] = {

@@ -151,6 +151,9 @@ class ProgramReport:
     param_table: List[ParamShardingEntry]
     flops: Optional[float] = None
     bytes_accessed: Optional[float] = None
+    #: memcheck's :class:`~diff3d_tpu.analysis.mem.MemoryReport` for the
+    #: same compiled program (None when analysis was skipped).
+    memory: Optional[object] = None
 
     @property
     def total_collective_bytes(self) -> int:
@@ -181,6 +184,8 @@ class ProgramReport:
             "num_params": len(self.param_table),
             "flops": self.flops,
             "bytes_accessed": self.bytes_accessed,
+            "memory": (self.memory.to_json()
+                       if self.memory is not None else None),
         }
 
 
@@ -339,7 +344,8 @@ def analyze_lowered(name: str, lowered, *, params_template=None,
     ``expected_param_shardings`` is the policy pytree to diff against
     (both optional — without them the param table is empty).
     """
-    shlo = parse_stablehlo(lowered.as_text())
+    stablehlo_text = lowered.as_text()
+    shlo = parse_stablehlo(stablehlo_text)
     compiled = lowered.compile()
     hlo_text = compiled.as_text()
     collectives = parse_compiled_collectives(hlo_text)
@@ -367,13 +373,20 @@ def analyze_lowered(name: str, lowered, *, params_template=None,
         table = table or []
 
     cost = cost_summary(compiled)
+    # memcheck rides the same lower+compile pass (lazy import: mem
+    # depends on this module for the dtype table).
+    from diff3d_tpu.analysis import mem as _mem
+
+    memory = _mem.build_memory_report(
+        name, stablehlo_text, compiled,
+        requested=_mem.requested_donations(lowered))
     return ProgramReport(
         name=name, mesh_shape=mesh_shape, collectives=collectives,
         resharding_sites=shlo["resharding_sites"],
         dtype_upcasts=shlo["dtype_upcasts"],
         host_callbacks=sorted(shlo["host_callbacks"]),
         param_table=table, flops=cost["flops"],
-        bytes_accessed=cost["bytes_accessed"])
+        bytes_accessed=cost["bytes_accessed"], memory=memory)
 
 
 def analyze_jitted(name: str, fn, *abstract_args, params_template=None,

@@ -1,0 +1,268 @@
+"""Per-program memory budget manifests for memcheck.
+
+A **manifest** pins one compiled program's memory footprint: peak HBM
+(argument + output + temp + generated-code bytes, aliased bytes counted
+once), temp bytes on their own (the scratch XLA materialises between
+fusions — the number that moves when an optimisation boundary shifts),
+the donation table (which requested donations must stay effective), and
+the hoistable scan-invariant FLOPs per step (the recompute budget —
+ROADMAP item 2a's pinned number).  Manifests are JSON files committed
+under ``runs/memcheck/`` — one per registered program — so a PR that
+doubles the sampler's temp bytes or silently un-aliases the
+``record_imgs`` donation shows up as a *diff against a committed file*,
+reviewable like any other regression.
+
+Checking a :class:`~diff3d_tpu.analysis.mem.MemoryReport` against its
+manifest yields graftlint-compatible :class:`Finding`s (rules MC4xx,
+fingerprinted via ``fingerprint_data`` so they share the baseline
+format).  Suppressions follow the same reason-mandatory discipline as
+shardcheck manifests::
+
+    "suppressions": [
+      {"rule": "MC402", "key": "7",
+       "reason": "optimizer mu buffer donation blocked by psum layout"}
+    ]
+
+``key`` scopes the suppression to one subject (an arg index, a byte
+field); ``"*"`` covers the whole rule.  A suppression without a reason
+is itself reported (MC002, mirroring GL002/SC002).
+
+Rules:
+
+  MC002  manifest suppression without a reason             (warning)
+  MC401  peak-HBM bytes over budget                        (error)
+  MC402  requested donation not aliased by XLA             (error)
+  MC403  temp bytes over budget                            (error)
+  MC404  hoistable scan-invariant FLOPs/step over budget   (error)
+  MC405  program has no committed manifest                 (error)
+
+Budgets are pinned exactly from the observed report (lowering and
+compilation are deterministic for a fixed jax/XLA version, shapes and
+mesh): any drift is a diff a human reviews and either accepts by
+re-pinning with ``memcheck --update`` or fixes.  When the
+conditioning-branch reuse of ROADMAP item 2a lands, tightening the
+MC404 ceiling in ``runs/memcheck/step_many.json`` is the regression
+gate that keeps it from creeping back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from diff3d_tpu.analysis.lint import (Finding, SEVERITY_ERROR,
+                                      SEVERITY_WARNING)
+from diff3d_tpu.analysis.mem import MemoryReport
+
+#: Default manifest directory, relative to the repo root.
+DEFAULT_MANIFEST_DIR = os.path.join("runs", "memcheck")
+
+MANIFEST_VERSION = 1
+MANIFEST_TOOL = "memcheck"
+
+
+@dataclasses.dataclass
+class Suppression:
+    rule: str
+    key: str = "*"
+    reason: Optional[str] = None
+
+    def covers(self, rule: str, key: str) -> bool:
+        return self.rule == rule and self.key in ("*", key)
+
+
+@dataclasses.dataclass
+class MemBudget:
+    """The limits a manifest imposes.  Byte/FLOP fields are ceilings;
+    ``effective_donations`` lists arg indices whose requested donation
+    MUST alias (a requested donation outside the list still fires MC402
+    — the list exists so ``--update`` records which aliases the pin was
+    taken against, making the manifest diff reviewable)."""
+
+    peak_bytes: int = 0
+    temp_bytes: int = 0
+    hoistable_flops_per_step: float = 0.0
+    effective_donations: List[int] = dataclasses.field(
+        default_factory=list)
+
+
+@dataclasses.dataclass
+class MemManifest:
+    program: str
+    budgets: MemBudget
+    observed: dict = dataclasses.field(default_factory=dict)
+    suppressions: List[Suppression] = dataclasses.field(
+        default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "version": MANIFEST_VERSION,
+            "tool": MANIFEST_TOOL,
+            "program": self.program,
+            "budgets": dataclasses.asdict(self.budgets),
+            "observed": self.observed,
+            "suppressions": [dataclasses.asdict(s)
+                             for s in self.suppressions],
+        }
+
+
+def manifest_path(program: str, manifest_dir: str) -> str:
+    return os.path.join(manifest_dir, f"{program}.json")
+
+
+def load_manifest(path: str) -> MemManifest:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if (not isinstance(data, dict)
+            or data.get("version") != MANIFEST_VERSION
+            or data.get("tool") != MANIFEST_TOOL):
+        raise ValueError(f"{path}: not a memcheck manifest "
+                         f"(version {MANIFEST_VERSION})")
+    b = data.get("budgets", {})
+    budgets = MemBudget(
+        peak_bytes=int(b.get("peak_bytes", 0)),
+        temp_bytes=int(b.get("temp_bytes", 0)),
+        hoistable_flops_per_step=float(
+            b.get("hoistable_flops_per_step", 0.0)),
+        effective_donations=[int(x)
+                             for x in b.get("effective_donations", [])])
+    supps = [Suppression(rule=str(s.get("rule", "")),
+                         key=str(s.get("key", "*")),
+                         reason=s.get("reason"))
+             for s in data.get("suppressions", [])]
+    return MemManifest(program=str(data.get("program", "")),
+                       budgets=budgets,
+                       observed=data.get("observed", {}),
+                       suppressions=supps)
+
+
+def write_manifest(path: str, manifest: MemManifest) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(manifest.to_json(), f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def manifest_from_report(report: MemoryReport,
+                         suppressions: Optional[
+                             Sequence[Suppression]] = None) -> MemManifest:
+    """Pin a report as the budget: observed bytes/FLOPs become the
+    ceilings, currently-effective donations become mandatory."""
+    budgets = MemBudget(
+        peak_bytes=report.peak_bytes,
+        temp_bytes=report.temp_bytes,
+        hoistable_flops_per_step=report.hoistable_flops_per_step,
+        effective_donations=sorted(
+            d.arg_index for d in report.donations
+            if d.requested and d.effective))
+    return MemManifest(program=report.name, budgets=budgets,
+                       observed=report.to_json(),
+                       suppressions=list(suppressions or []))
+
+
+# -- checking ----------------------------------------------------------
+
+
+def _finding(manifest_file: str, rule: str, program: str, key: str,
+             message: str, severity: str = SEVERITY_ERROR) -> Finding:
+    return Finding(
+        path=manifest_file, rule=rule, line=1, col=0, severity=severity,
+        message=f"[{program}] {message}",
+        fingerprint_data=f"{program}\x00{rule}\x00{key}")
+
+
+def check_report(report: MemoryReport, manifest: MemManifest,
+                 manifest_file: str) -> List[Finding]:
+    """Diff a memory report against its manifest.  Returns ALL findings
+    (suppressed ones marked), same contract as ``lint_source``."""
+    raw: List[Finding] = []
+    b = manifest.budgets
+    prog = report.name
+
+    if report.available and report.peak_bytes > b.peak_bytes:
+        raw.append(_finding(
+            manifest_file, "MC401", prog, "peak_bytes",
+            f"peak HBM estimate {report.peak_bytes} bytes exceeds budget "
+            f"{b.peak_bytes} (+{report.peak_bytes - b.peak_bytes}) — the "
+            f"router's admission control sizes replicas from this pin"))
+
+    for d in report.donations:
+        if d.requested and not d.effective:
+            stage = ("jax could not pair the donated buffer with an "
+                     "output at lowering time"
+                     if not d.lowered else
+                     "XLA declined the alias at compile time")
+            raw.append(_finding(
+                manifest_file, "MC402", prog, str(d.arg_index),
+                f"donation of arg {d.arg_index} "
+                f"({d.type or 'unknown type'}, {d.bytes} bytes) was "
+                f"requested but never aliased — {stage}; the buffer is "
+                f"silently copied and lives twice"))
+
+    if report.available and report.temp_bytes > b.temp_bytes:
+        raw.append(_finding(
+            manifest_file, "MC403", prog, "temp_bytes",
+            f"temp bytes {report.temp_bytes} exceed budget "
+            f"{b.temp_bytes} (+{report.temp_bytes - b.temp_bytes}) — "
+            f"scratch between fusions grew; check for a lost fusion or "
+            f"a materialised broadcast"))
+
+    hoist = report.hoistable_flops_per_step
+    if hoist > b.hoistable_flops_per_step:
+        raw.append(_finding(
+            manifest_file, "MC404", prog, "hoistable_flops_per_step",
+            f"scan-invariant compute {hoist:.6g} FLOPs/step exceeds "
+            f"budget {b.hoistable_flops_per_step:.6g} — loop-invariant "
+            f"ops were added to (or stopped being hoisted out of) a "
+            f"scan body; each one re-runs every denoise step"))
+
+    return _apply_suppressions(raw, manifest, manifest_file, prog)
+
+
+def _apply_suppressions(raw: Sequence[Finding], manifest: MemManifest,
+                        manifest_file: str, prog: str) -> List[Finding]:
+    out: List[Finding] = []
+    for f in raw:
+        key = (f.fingerprint_data or "").split("\x00")[-1]
+        supp = next((s for s in manifest.suppressions
+                     if s.covers(f.rule, key)), None)
+        if supp is not None:
+            f = dataclasses.replace(f, suppressed=True,
+                                    suppress_reason=supp.reason)
+        out.append(f)
+    # Reason-mandatory, like graftlint/shardcheck suppressions.
+    for s in manifest.suppressions:
+        if not s.reason:
+            out.append(_finding(
+                manifest_file, "MC002", prog, f"{s.rule}:{s.key}",
+                f"manifest suppression of {s.rule} (key={s.key!r}) has "
+                f"no reason — every suppression documents why it is "
+                f"safe", severity=SEVERITY_WARNING))
+    return out
+
+
+def missing_manifest_finding(program: str,
+                             manifest_dir: str) -> Finding:
+    path = manifest_path(program, manifest_dir)
+    return _finding(
+        path, "MC405", program, "missing",
+        f"no committed manifest at {path} — run "
+        f"'memcheck --update --program {program}' and commit the "
+        f"result")
+
+
+def check_report_against_dir(report: MemoryReport,
+                             manifest_dir: str) -> List[Finding]:
+    """Load ``<dir>/<program>.json`` and check; a missing or unreadable
+    manifest is itself a finding (MC405)."""
+    path = manifest_path(report.name, manifest_dir)
+    if not os.path.exists(path):
+        return [missing_manifest_finding(report.name, manifest_dir)]
+    try:
+        manifest = load_manifest(path)
+    except (ValueError, json.JSONDecodeError) as e:
+        return [_finding(path, "MC405", report.name, "unreadable",
+                         f"manifest unreadable: {e}")]
+    return check_report(report, manifest, path)

@@ -1,0 +1,164 @@
+"""memcheck — memory/recompute gate over the repo's pjit programs.
+
+The fourth analysis pillar (graftlint AST, shardcheck IR/comms,
+lockcheck concurrency, **memcheck memory**).  It deliberately has no
+program registry of its own: the programs whose comms footprint
+shardcheck pins are exactly the programs whose memory footprint matters
+(sharded train step, distill step, step_many ancestral + DDIM, serving
+warmup), so this module reuses
+:data:`~diff3d_tpu.analysis.shardcheck.REGISTRY` and rides the same
+lower+compile pass — ``ir.analyze_lowered`` attaches a
+:class:`~diff3d_tpu.analysis.mem.MemoryReport` to every
+:class:`~diff3d_tpu.analysis.ir.ProgramReport` it builds, and this CLI
+diffs those against manifests under ``runs/memcheck/`` (rules MC4xx,
+``docs/DESIGN.md`` §13).
+
+Workflow mirrors shardcheck::
+
+    memcheck                      # check all programs vs manifests
+    memcheck --programs-tier1     # the tier-1 gate (tools/lint.py)
+    memcheck --update             # re-pin manifests, keep suppressions
+    memcheck --program step_many --format json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from diff3d_tpu.analysis import membudgets as membudgets_lib
+from diff3d_tpu.analysis import shardcheck as shardcheck_lib
+from diff3d_tpu.analysis.lint import Finding
+from diff3d_tpu.analysis.mem import MemoryReport, memory_summary
+from diff3d_tpu.analysis.shardcheck import (REGISTRY, TIER1_PROGRAMS,
+                                            ensure_cpu_mesh_devices)
+
+
+def default_manifest_dir(root: Optional[str] = None) -> str:
+    if root is None:
+        root = shardcheck_lib._find_root()
+    return os.path.join(root, membudgets_lib.DEFAULT_MANIFEST_DIR)
+
+
+def memory_report_for(name: str) -> MemoryReport:
+    """Build the registered program (through shardcheck's in-process
+    report cache — both pillars analyze the same compiled programs)
+    and return its memory report."""
+    report = shardcheck_lib.build_report(name)
+    mem = report.memory
+    if mem is None:
+        # analyze_lowered always attaches one; a None here means an
+        # out-of-band builder — treat as an empty (nothing-observed)
+        # report so budget checks still run.
+        mem = MemoryReport(name=name, available=False)
+    return mem
+
+
+def check_programs(names: Sequence[str], manifest_dir: str,
+                   reports_out: Optional[list] = None) -> List[Finding]:
+    """Build + analyze each named program and diff its memory report
+    against the committed manifest.  Returns ALL findings (suppressed
+    marked), ``lint_source``-style."""
+    findings: List[Finding] = []
+    for nm in names:
+        mem = memory_report_for(nm)
+        if reports_out is not None:
+            reports_out.append(mem)
+        findings.extend(
+            membudgets_lib.check_report_against_dir(mem, manifest_dir))
+    return findings
+
+
+def update_manifests(names: Sequence[str], manifest_dir: str) -> List[str]:
+    """Re-pin each named program's manifest from its current memory
+    report, PRESERVING any suppressions the committed manifest carries
+    (they are reviewed policy, not observations)."""
+    written = []
+    for nm in names:
+        mem = memory_report_for(nm)
+        path = membudgets_lib.manifest_path(nm, manifest_dir)
+        supps: list = []
+        if os.path.exists(path):
+            try:
+                supps = membudgets_lib.load_manifest(path).suppressions
+            except (ValueError, json.JSONDecodeError):
+                pass
+        membudgets_lib.write_manifest(
+            path, membudgets_lib.manifest_from_report(mem, supps))
+        written.append(path)
+    return written
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="memcheck",
+        description="HLO-level memory & recompute analyzer over the "
+                    "repo's pjit programs (rules MC4xx; see "
+                    "docs/DESIGN.md §13)")
+    p.add_argument("--program", action="append", default=None,
+                   choices=sorted(REGISTRY), dest="programs",
+                   help="check one program (repeatable; default: all)")
+    p.add_argument("--programs-tier1", action="store_true",
+                   help=f"check only the tier-1 set {TIER1_PROGRAMS}")
+    p.add_argument("--manifest-dir", default=None,
+                   help="manifest directory (default <root>/"
+                        f"{membudgets_lib.DEFAULT_MANIFEST_DIR})")
+    p.add_argument("--update", action="store_true",
+                   help="write manifests pinned to the current reports "
+                        "(keeps existing suppressions) and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--show-suppressed", action="store_true")
+    p.add_argument("--list", action="store_true", dest="list_programs",
+                   help="list registered programs")
+    args = p.parse_args(argv)
+
+    if args.list_programs:
+        for spec in REGISTRY.values():
+            tag = " [tier1]" if spec.tier1 else ""
+            print(f"{spec.name:18s} {spec.description}{tag}")
+        return 0
+
+    if args.programs and args.programs_tier1:
+        print("memcheck: --program and --programs-tier1 are exclusive",
+              file=sys.stderr)
+        return 2
+    names = (args.programs or
+             (list(TIER1_PROGRAMS) if args.programs_tier1
+              else sorted(REGISTRY)))
+    manifest_dir = args.manifest_dir or default_manifest_dir()
+
+    ensure_cpu_mesh_devices()
+
+    if args.update:
+        for path in update_manifests(names, manifest_dir):
+            print(f"memcheck: wrote {path}")
+        return 0
+
+    reports: list = []
+    findings = check_programs(names, manifest_dir, reports_out=reports)
+    live = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    if args.format == "json":
+        print(json.dumps({
+            "reports": [r.to_json() for r in reports],
+            "summaries": {r.name: memory_summary(r) for r in reports},
+            "findings": [dataclasses.asdict(f) for f in findings],
+            "unsuppressed": len(live),
+            "suppressed": len(suppressed),
+        }, indent=1))
+    else:
+        shown = findings if args.show_suppressed else live
+        for f in shown:
+            print(f.render())
+        print(f"memcheck: {len(live)} finding(s), "
+              f"{len(suppressed)} suppressed, "
+              f"{len(names)} program(s)")
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

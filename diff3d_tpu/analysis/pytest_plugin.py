@@ -31,6 +31,16 @@ package — pytest resolves the module off ``sys.path``).  Exposes:
     observed.  The test must request the ``lock_witness`` fixture, and
     a marked test under which no lock was ever acquired fails — the
     check would pass vacuously.
+  * ``@pytest.mark.memory_budget(...)`` — the test's analyzed programs
+    (memory reports registered with the ``mem_check`` fixture) may not
+    exceed the given memory limits, aggregated over every registered
+    report and enforced at teardown.  Keywords: ``peak_bytes`` /
+    ``temp_bytes`` bound the summed byte footprints,
+    ``hoistable_flops_per_step`` bounds the summed scan-invariant
+    recompute, and ``ineffective_donations`` (default 0 when the marker
+    is used) bounds the number of requested-but-unaliased donations.
+    Same vacuous-pass protection: a marked test that never registers a
+    report fails.
 """
 
 from __future__ import annotations
@@ -91,6 +101,51 @@ class CommsCheck:
         return out
 
 
+_MEMORY_KEYS = ("peak_bytes", "temp_bytes", "hoistable_flops_per_step",
+                "ineffective_donations")
+
+
+class MemCheck:
+    """Accumulates :class:`~diff3d_tpu.analysis.mem.MemoryReport`s for
+    the ``memory_budget`` marker.  ``add`` takes a ready report;
+    ``analyze`` lowers+compiles+analyzes in place."""
+
+    def __init__(self):
+        self.reports = []
+
+    def add(self, report):
+        self.reports.append(report)
+        return report
+
+    def analyze(self, name: str, lowered):
+        from diff3d_tpu.analysis.mem import analyze_lowered_memory
+
+        return self.add(analyze_lowered_memory(name, lowered))
+
+    def violations(self, limits: dict) -> list:
+        """Human-readable budget breaches, aggregated over reports."""
+        peak = sum(r.peak_bytes for r in self.reports)
+        temp = sum(r.temp_bytes for r in self.reports)
+        hoist = sum(r.hoistable_flops_per_step for r in self.reports)
+        ineff = sum(len(r.ineffective_donations) for r in self.reports)
+        out = []
+        for key, got in (("peak_bytes", peak), ("temp_bytes", temp),
+                         ("hoistable_flops_per_step", hoist)):
+            limit = limits.get(key)
+            if limit is not None and got > limit:
+                out.append(f"{key}: {got:g} > budget {limit:g}")
+        # Ineffective donations default to forbidden under the marker:
+        # requesting a donation that silently copies is always a bug
+        # unless the test explicitly budgets for it.
+        limit = limits.get("ineffective_donations", 0)
+        if ineff > limit:
+            args = [f"{r.name} arg {i}" for r in self.reports
+                    for i in r.ineffective_donations]
+            out.append(f"ineffective_donations: {ineff} > budget {limit}"
+                       f" ({', '.join(args)})")
+        return out
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
@@ -109,6 +164,13 @@ def pytest_configure(config):
         "lock_witness: run the test with the runtime lock-order witness "
         "installed (via the lock_witness fixture); fails at teardown on "
         "any lock-order cycle or held-lock wait")
+    config.addinivalue_line(
+        "markers",
+        "memory_budget(peak_bytes=n, temp_bytes=n, "
+        "hoistable_flops_per_step=n, ineffective_donations=n): the "
+        "programs analyzed via the mem_check fixture may not exceed "
+        "these memory/recompute limits (aggregated; enforced at "
+        "teardown; ineffective donations forbidden unless budgeted)")
 
 
 @pytest.hookimpl(tryfirst=True)
@@ -144,6 +206,26 @@ def pytest_runtest_setup(item):
             pytest.fail(
                 f"{item.nodeid}: @pytest.mark.comms_budget requires the "
                 "comms_check fixture — request it and analyze the "
+                "lowered programs under test", pytrace=False)
+
+    marker = item.get_closest_marker("memory_budget")
+    if marker is not None:
+        if marker.args:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.memory_budget takes only "
+                f"keywords ({', '.join(_MEMORY_KEYS)}), e.g. "
+                "memory_budget(peak_bytes=2**30)", pytrace=False)
+        bad = sorted(set(marker.kwargs) - set(_MEMORY_KEYS))
+        if bad or not marker.kwargs:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.memory_budget got "
+                f"{'unknown keys ' + ', '.join(bad) if bad else 'no limits'}"
+                f" — valid keys: {', '.join(_MEMORY_KEYS)}",
+                pytrace=False)
+        if "mem_check" not in item.fixturenames:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.memory_budget requires "
+                "the mem_check fixture — request it and analyze the "
                 "lowered programs under test", pytrace=False)
 
     marker = item.get_closest_marker("lock_witness")
@@ -193,6 +275,27 @@ def comms_check(request):
         names = ", ".join(r.name for r in check.reports)
         pytest.fail(
             f"{request.node.nodeid}: comms budget exceeded over "
+            f"[{names}]:\n  " + "\n  ".join(violations), pytrace=False)
+
+
+@pytest.fixture
+def mem_check(request):
+    check = MemCheck()
+    yield check
+    marker = request.node.get_closest_marker("memory_budget")
+    if marker is None:
+        return
+    if not check.reports:
+        pytest.fail(
+            f"{request.node.nodeid}: memory_budget(...) but no program "
+            "was analyzed — the budget would pass vacuously; call "
+            "mem_check.analyze(name, lowered) or mem_check.add(r)",
+            pytrace=False)
+    violations = check.violations(marker.kwargs)
+    if violations:
+        names = ", ".join(r.name for r in check.reports)
+        pytest.fail(
+            f"{request.node.nodeid}: memory budget exceeded over "
             f"[{names}]:\n  " + "\n  ".join(violations), pytrace=False)
 
 

@@ -255,13 +255,33 @@ def _find_root() -> str:
     return cur
 
 
+#: In-process report cache.  Lowering is deterministic for a fixed tree
+#: (the whole premise of the manifest gates), and the shardcheck and
+#: memcheck pillars analyze the SAME programs — one build feeds both
+#: when they run in one process (tools/lint.py, the tier-1 pytest run).
+#: Keyed by (name, builder) so a test that monkeypatches a REGISTRY
+#: entry's ``build`` never sees a stale cached report.
+_REPORT_CACHE: Dict[tuple, "ir.ProgramReport"] = {}
+
+
+def build_report(name: str) -> "ir.ProgramReport":
+    """Build (or fetch the cached) :class:`ir.ProgramReport` for a
+    registered program."""
+    spec = REGISTRY[name]
+    key = (name, spec.build)
+    report = _REPORT_CACHE.get(key)
+    if report is None:
+        report = _REPORT_CACHE[key] = spec.build()
+    return report
+
+
 def check_programs(names: Sequence[str], manifest_dir: str,
                    reports_out: Optional[list] = None) -> List[Finding]:
     """Build + analyze each named program and diff against its manifest.
     Returns ALL findings (suppressed marked), ``lint_source``-style."""
     findings: List[Finding] = []
     for nm in names:
-        report = REGISTRY[nm].build()
+        report = build_report(nm)
         if reports_out is not None:
             reports_out.append(report)
         findings.extend(
@@ -275,7 +295,7 @@ def update_manifests(names: Sequence[str], manifest_dir: str) -> List[str]:
     reviewed policy, not observations)."""
     written = []
     for nm in names:
-        report = REGISTRY[nm].build()
+        report = build_report(nm)
         path = budgets_lib.manifest_path(nm, manifest_dir)
         supps: list = []
         if os.path.exists(path):

@@ -150,7 +150,11 @@ class ProgramCache:
                 entry = self._programs[key] = {
                     "compile_s": None, "uses": 0,
                     "steps": getattr(sampler, "steps", None),
-                    "sampler": getattr(sampler, "sampler_kind", None)}
+                    "sampler": getattr(sampler, "sampler_kind", None),
+                    # Bucket object kept so stats() can re-lower the
+                    # program for its memory footprint (memory=None
+                    # until first computed; guarded-by: self._lock).
+                    "bucket": bucket, "memory": None}
             entry["uses"] += 1
         if first and self._compiles:
             self._compiles.inc()
@@ -199,12 +203,52 @@ class ProgramCache:
         return sampler.lower_step_many(int(lanes), int(cap),
                                        H=int(H), W=int(W))
 
+    def _memory_of(self, key: tuple) -> Optional[dict]:
+        """Per-program memory footprint (peak HBM estimate + argument
+        bytes) from the compiled executable's memory analysis, computed
+        at most once per program and cached in its entry.  The compile
+        happens OUTSIDE the lock (jax's compilation cache makes
+        re-compiling the already-warmed program cheap); best-effort —
+        a backend without memory analysis yields None, never an error
+        in the ``/stats`` path."""
+        with self._lock:
+            entry = self._programs.get(key)
+            if entry is None or entry.get("memory") is not None:
+                return entry.get("memory") if entry else None
+            bucket = entry.get("bucket")
+        if bucket is None:           # pre-existing entry shape (tests)
+            return None
+        try:
+            from diff3d_tpu.analysis import mem as mem_lib
+
+            compiled = self.lower(bucket, key[1]).compile()
+            stats = mem_lib.compiled_memory_stats(compiled)
+            memory = None
+            if stats is not None:
+                memory = {
+                    "peak_bytes": (stats["argument_bytes"]
+                                   + stats["output_bytes"]
+                                   + stats["temp_bytes"]
+                                   + stats["generated_code_bytes"]
+                                   - stats["alias_bytes"]),
+                    "argument_bytes": stats["argument_bytes"],
+                    "temp_bytes": stats["temp_bytes"],
+                }
+        except Exception:
+            memory = None
+        if memory is not None:
+            with self._lock:
+                entry = self._programs.get(key)
+                if entry is not None:
+                    entry["memory"] = memory
+        return memory
+
     def supported_schedules(self) -> list:
         """Sorted ``"kind:steps"`` strings of the routable samplers."""
         return sorted(
             f"{k[0]}:{k[1]}" for k in self._samplers)
 
-    def stats(self) -> dict:
+    def stats(self, include_memory: bool = False) -> dict:
         default = (getattr(self._sampler, "sampler_kind", None),
                    getattr(self._sampler, "steps", None))
 
@@ -222,6 +266,19 @@ class ProgramCache:
             return s + f"xlanes{lanes}"
 
         with self._lock:
+            keys = list(self._programs)
+        # Fill per-program memory blocks before snapshotting (cached
+        # after the first request per program; lock released —
+        # _memory_of may compile).  Opt-in: the compile-free callers
+        # (metrics snapshots, health) skip it, reporting whatever a
+        # prior memory-including call already cached.
+        if include_memory:
+            memory = {k: self._memory_of(k) for k in keys}
+        else:
+            with self._lock:
+                memory = {k: self._programs[k].get("memory")
+                          for k in keys if k in self._programs}
+        with self._lock:
             return {
                 "programs": {
                     name(k): {
@@ -229,6 +286,10 @@ class ProgramCache:
                         "compile_s": v["compile_s"],
                         "steps": v.get("steps"),
                         "sampler": v.get("sampler"),
+                        "peak_bytes": (memory.get(k) or {}).get(
+                            "peak_bytes"),
+                        "argument_bytes": (memory.get(k) or {}).get(
+                            "argument_bytes"),
                     } for k, v in self._programs.items()
                 },
                 "num_programs": len(self._programs),

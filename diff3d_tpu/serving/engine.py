@@ -369,8 +369,13 @@ class Engine:
         with self._health_lock:
             return self._health
 
-    def snapshot_extra(self) -> dict:
-        """Engine-level details merged into the metrics snapshot."""
+    def snapshot_extra(self, include_memory: bool = False) -> dict:
+        """Engine-level details merged into the metrics snapshot.
+
+        ``include_memory`` opts into the per-program memory blocks in the
+        program-cache stats; the first request per program compiles, so
+        only the explicit ``/stats`` route pays for it — hot paths
+        (``/metrics``, health polls) stay compile-free."""
         return {
             "engine": {
                 "alive": self.alive,
@@ -382,7 +387,8 @@ class Engine:
                 "effective_max_batch": self._effective_max_batch(),
                 "num_devices": jax.device_count(),
                 "step_timer": self.step_timer.summary(),
-                "program_cache": self.programs.stats(),
+                "program_cache": self.programs.stats(
+                    include_memory=include_memory),
                 "result_cache_entries": len(self.result_cache),
                 "default_schedule": (
                     f"{self.default_schedule[0]}:{self.default_schedule[1]}"),

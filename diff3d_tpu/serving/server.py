@@ -220,8 +220,9 @@ class ServingService:
             "supported_schedules": self.engine.supported_schedules(),
         }
 
-    def metrics_snapshot(self) -> dict:
-        return self.metrics.snapshot(extra=self.engine.snapshot_extra())
+    def metrics_snapshot(self, include_memory: bool = False) -> dict:
+        return self.metrics.snapshot(
+            extra=self.engine.snapshot_extra(include_memory=include_memory))
 
 
 def make_http_server(service: ServingService, host: str,
@@ -266,7 +267,8 @@ def make_http_server(service: ServingService, host: str,
                 else:
                     self._send_text(200, service.metrics.exposition())
             elif url.path == "/stats":
-                self._send_json(200, service.metrics_snapshot())
+                self._send_json(
+                    200, service.metrics_snapshot(include_memory=True))
             elif url.path.startswith("/result/"):
                 req = service.get_request(url.path[len("/result/"):])
                 if req is None:

@@ -307,12 +307,17 @@ def test_ddim_end_to_end_through_sharded_serving(setup):
         np.testing.assert_allclose(out_d, ref_d, atol=1e-5, rtol=1e-5)
         np.testing.assert_allclose(out_a, ref_a, atol=1e-5, rtol=1e-5)
 
-        stats = service.engine.programs.stats()
+        stats = service.engine.programs.stats(include_memory=True)
         names = sorted(stats["programs"])
         assert names == ["H8xW8xcap4xddim2xlanes2", "H8xW8xcap4xlanes2"]
         ddim_entry = stats["programs"]["H8xW8xcap4xddim2xlanes2"]
         assert (ddim_entry["steps"], ddim_entry["sampler"]) == (2, "ddim")
         assert stats["supported_schedules"] == ["ancestral:4", "ddim:2"]
+        # memcheck satellite: every program carries its compiled memory
+        # footprint (peak-HBM estimate + argument bytes) in /stats.
+        for entry in stats["programs"].values():
+            assert entry["peak_bytes"] > 0
+            assert entry["argument_bytes"] > 0
     finally:
         service.stop()
 

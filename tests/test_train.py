@@ -39,6 +39,21 @@ def make_batch(cfg, B=8, seed=0):
             "T": jnp.asarray(b["T"]), "K": jnp.asarray(b["K"])}
 
 
+@pytest.fixture
+def partitionable_rng():
+    """Run the test under partitionable threefry.  With the legacy
+    lowering, ``jax.random`` produces DIFFERENT bits when its output is
+    sharded vs replicated, so a mesh-sharded step can never be
+    bit-compared against its single-device oracle — the root cause of
+    the long-standing context-parallel trajectory mismatches.
+    Partitionable threefry makes the bits a pure function of
+    key+position, independent of output sharding.  Scoped to the
+    equality tests (not package-global) because the partitionable
+    lowering roughly doubles RNG cost on the CPU test backend."""
+    with jax.threefry_partitionable(True):
+        yield
+
+
 def test_warmup_schedule_linear_then_flat():
     cfg = TrainConfig(lr=1e-4, warmup_examples=1000, global_batch=100)
     sched = warmup_schedule(cfg)  # 10 warmup steps, (step+1)/10 ramp
@@ -143,12 +158,15 @@ def test_replicated_and_sharded_steps_agree():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+_TRAJ_REF_CACHE = []
+
+
 @pytest.mark.parametrize("mesh_cfg", [
     MeshConfig(param_sharding="fsdp"),
     MeshConfig(model_parallel=2, param_sharding="fsdp+tp"),
     MeshConfig(model_parallel=2, context_parallel=True),
 ], ids=["fsdp", "fsdp+tp", "context-parallel"])
-def test_multi_step_trajectory_equality(mesh_cfg):
+def test_multi_step_trajectory_equality(mesh_cfg, partitionable_rng):
     """25-step TRAJECTORY equality: the sharded step must track the
     single-device step through a long chain of Adam/EMA updates and
     step-folded rng draws, not just agree on one update (r3 VERDICT:
@@ -180,7 +198,14 @@ def test_multi_step_trajectory_equality(mesh_cfg):
         return (np.asarray(losses), jax.device_get(s.params),
                 jax.device_get(s.ema_params))
 
-    ref_losses, ref_params, ref_ema = run(None, cfg)
+    # The unsharded reference trajectory is identical for every mesh
+    # parametrization (same PRNGKey(0) init, same batch cycle, same
+    # partitionable-threefry fixture), so compute it once per module
+    # run instead of once per parametrization — recomputing it tripled
+    # the reference cost for no extra coverage.
+    if not _TRAJ_REF_CACHE:
+        _TRAJ_REF_CACHE.append(run(None, cfg))
+    ref_losses, ref_params, ref_ema = _TRAJ_REF_CACHE[0]
     cfg_sharded = dataclasses.replace(cfg, mesh=mesh_cfg)
     env = make_mesh(mesh_cfg)
     losses, params_s, ema_s = run(env, cfg_sharded)
@@ -508,7 +533,7 @@ def test_grad_accumulation_rejects_indivisible_batch():
         cfg.validate()
 
 
-def test_context_parallel_step_matches_replicated():
+def test_context_parallel_step_matches_replicated(partitionable_rng):
     """GSPMD context parallelism (spatial axis sharded over the model
     axis via activation constraints) computes the same update as the
     unsharded step — XLA's halo exchange / GN reduction / KV gathers are

@@ -1,7 +1,7 @@
 """Repo static-analysis gate, runnable as a plain script:
 ``python tools/lint.py``.
 
-Runs ALL THREE passes as one gate (nonzero exit if any finds anything
+Runs ALL FOUR passes as one gate (nonzero exit if any finds anything
 unsuppressed):
 
   * **graftlint** — the AST pass (rules GL1xx, docs/DESIGN.md §9);
@@ -13,12 +13,16 @@ unsuppressed):
   * **lockcheck** — the concurrency pass (rules LC3xx, docs/DESIGN.md
     §12): lock-order graphs, ``# guarded-by:`` discipline and
     blocking-under-lock checks over the threaded serving/checkpoint
-    runtime.
+    runtime;
+  * **memcheck** — the memory pass over the same tier-1 program set
+    (rules MC4xx, docs/DESIGN.md §13): peak-HBM/temp budgets,
+    donation-effectiveness verification and scan-invariant recompute
+    ceilings against the manifests under ``runs/memcheck/``.
 
-``--ast-only`` / ``--ir-only`` / ``--lock-only`` select one pass; all
-other arguments pass through to the selected pass — with multiple
-passes active only argument-free invocation is supported
-(pass-specific flags differ).  Works from a checkout without
+``--ast-only`` / ``--ir-only`` / ``--lock-only`` / ``--mem-only``
+select one pass; all other arguments pass through to the selected pass
+— with multiple passes active only argument-free invocation is
+supported (pass-specific flags differ).  Works from a checkout without
 installing the package.
 """
 
@@ -27,7 +31,7 @@ from __future__ import annotations
 import os
 import sys
 
-_ONLY_FLAGS = ("--ast-only", "--ir-only", "--lock-only")
+_ONLY_FLAGS = ("--ast-only", "--ir-only", "--lock-only", "--mem-only")
 
 
 def main() -> int:
@@ -58,6 +62,10 @@ def main() -> int:
     if selected in (None, "--ir-only"):
         from diff3d_tpu.analysis.shardcheck import main as shardcheck_main
         rc = max(rc, shardcheck_main(
+            argv if selected else ["--programs-tier1"]))
+    if selected in (None, "--mem-only"):
+        from diff3d_tpu.analysis.memcheck import main as memcheck_main
+        rc = max(rc, memcheck_main(
             argv if selected else ["--programs-tier1"]))
     return rc
 
