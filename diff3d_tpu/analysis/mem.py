@@ -77,6 +77,9 @@ _ALIAS_HEADER_RE = re.compile(r"input_output_alias=\{(.*?)\}(?:, |\n)",
                               re.DOTALL)
 _ARG_ATTR_RE = re.compile(
     r"%arg(\d+):\s*tensor<([^>]*)>((?:\s*\{)?)")
+_SHARDING_ATTR_RE = re.compile(r'mhlo\.sharding\s*=\s*"([^"]*)"')
+_SHARDING_DEVICES_RE = re.compile(r"devices=\[([0-9,\s]+)\]")
+_LAST_TILE_DIMS_RE = re.compile(r"last_tile_dims=\{([^}]*)\}")
 
 
 def _tensor_numel_dtype(t: str) -> Tuple[int, str]:
@@ -95,6 +98,36 @@ def _tensor_bytes(t: str) -> int:
     return n * _DTYPE_BYTES.get(dtype, 4)
 
 
+def _shard_count(sharding: Optional[str]) -> int:
+    """How many shards an ``mhlo.sharding`` annotation splits a tensor
+    into — the divisor that turns the global StableHLO tensor size into
+    the per-device bytes ``memory_analysis()`` accounts in.
+
+    ``{replicated}`` / ``{maximal device=k}`` / absent -> 1;
+    ``{devices=[8,1,1]<=[8]}`` -> 8;
+    ``{devices=[2,1,4]<=[8] last_tile_dim_replicate}`` -> 2 (the last
+    tile dim replicates across 4 devices, it does not tile);
+    ``last_tile_dims={...}`` subgroup dims likewise do not tile.
+    """
+    if not sharding:
+        return 1
+    m = _SHARDING_DEVICES_RE.search(sharding)
+    if not m:
+        return 1
+    dims = [int(d) for d in m.group(1).replace(" ", "").split(",") if d]
+    lm = _LAST_TILE_DIMS_RE.search(sharding)
+    if lm:
+        drop = len([e for e in lm.group(1).split(",") if e.strip()])
+    elif "last_tile_dim_replicate" in sharding:
+        drop = 1
+    else:
+        drop = 0
+    tiles = 1
+    for d in (dims[:len(dims) - drop] if drop else dims):
+        tiles *= d
+    return max(1, tiles)
+
+
 # -- donation tables ---------------------------------------------------
 
 
@@ -103,12 +136,14 @@ class DonationEntry:
     """One flattened entry argument's donation story, end to end."""
 
     arg_index: int
-    type: str                 # tensor type text, e.g. "8x4x8x8x3xf32"
-    bytes: int
+    type: str                 # GLOBAL tensor type text, e.g. "8x4x8x8x3xf32"
+    bytes: int                # PER-DEVICE bytes (global size / shard_count)
+    #                           — the unit memory_analysis() accounts in
     requested: bool           # Python layer asked (donate_argnums/donor)
     lowered: bool             # jax established an alias / donor mark
     effective: bool           # XLA's compiled module aliases this param
     output_index: Optional[int] = None   # aliased output, when effective
+    shard_count: int = 1      # from the arg's mhlo.sharding annotation
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -116,8 +151,10 @@ class DonationEntry:
 
 def parse_arg_donations(stablehlo_text: str) -> Dict[int, dict]:
     """Per-arg donation attributes of ``@main``: ``tf.aliasing_output``
-    (jax paired the donated arg with an output) and ``jax.buffer_donor``
-    (donated, pairing left to XLA)."""
+    (jax paired the donated arg with an output), ``jax.buffer_donor``
+    (donated, pairing left to XLA), and the ``mhlo.sharding`` annotation
+    (the tensor type is the GLOBAL shape; the sharding says how many
+    devices split it)."""
     m = re.search(r"func\.func\s+public\s+@main\((.*)$",
                   stablehlo_text, re.MULTILINE)
     if not m:
@@ -133,10 +170,12 @@ def parse_arg_donations(stablehlo_text: str) -> Dict[int, dict]:
         ttype = tm.group(1) if tm else ""
         am = re.search(r"tf\.aliasing_output\s*=\s*(\d+)", body)
         donor = "jax.buffer_donor" in body
+        sm = _SHARDING_ATTR_RE.search(body)
         out[idx] = {
             "type": ttype,
             "aliasing_output": int(am.group(1)) if am else None,
             "buffer_donor": donor,
+            "sharding": sm.group(1) if sm else None,
         }
     return out
 
@@ -175,16 +214,22 @@ def donation_table(requested: Sequence[bool],
         attrs = lowered_attrs.get(i, {})
         ttype = attrs.get("type", "")
         alias = aliased_params.get(i)
+        # The StableHLO type is the GLOBAL shape; memory_analysis()
+        # accounts per-device bytes.  Divide by the arg's shard count so
+        # the two live in the same unit (MC402 messages, alias
+        # discount) — on the 8-way fsdp mesh the difference is 8x.
+        shards = _shard_count(attrs.get("sharding"))
         table.append(DonationEntry(
             arg_index=i,
             type=ttype,
-            bytes=_tensor_bytes(ttype) if ttype else 0,
+            bytes=_tensor_bytes(ttype) // shards if ttype else 0,
             requested=bool(i < len(requested) and requested[i]),
             lowered=bool(attrs.get("aliasing_output") is not None
                          or attrs.get("buffer_donor")),
             effective=alias is not None,
             output_index=(alias["output_index"]
-                          if alias is not None else None)))
+                          if alias is not None else None),
+            shard_count=shards))
     return table
 
 
@@ -224,14 +269,6 @@ def _line_types(line: str) -> List[str]:
     else:
         return []
     return _TENSOR_RE.findall(seg)
-
-
-def _rhs_operands(line: str, lhs: Optional[str]) -> List[str]:
-    """%-tokens on the statement's RHS (excluding the lhs binding)."""
-    rhs = line.split("=", 1)[1] if (lhs and "=" in line) else line
-    # Attribute segments like `sizes = [1]` hold no %-tokens; keep all.
-    toks = [_base(t) for t in _VAR_RE.findall(rhs)]
-    return [t for t in toks if not t.startswith("%iterArg") or True]
 
 
 def parse_functions(txt: str) -> Dict[str, _Func]:
@@ -676,6 +713,8 @@ def build_memory_report(name: str, stablehlo_text: str, compiled,
         # donation discount depending on cache state.  The compiled
         # header's alias table is cache-stable, so derive the discount
         # from the (already parsed) donation table when it is larger.
+        # Both sides are per-device: donation bytes are the global
+        # StableHLO size divided by the arg's shard count.
         report.alias_bytes = max(
             stats["alias_bytes"],
             sum(d.bytes for d in report.donations if d.effective))

@@ -122,6 +122,53 @@ def test_donation_table_joins_three_sources():
     assert not by_idx[2].effective
     # arg1: never part of the donation story.
     assert 1 not in by_idx
+    # No mhlo.sharding annotations: global == per-device bytes.
+    assert by_idx[0].shard_count == 1 and by_idx[0].bytes == 256
+
+
+def test_shard_count_parses_hlo_sharding_annotations():
+    # Fire: tiled shardings divide.
+    assert mem._shard_count("{devices=[8,1,1,1,1,1]<=[8]}") == 8
+    assert mem._shard_count("{devices=[2,2,2]0,1,2,3,4,5,6,7}") == 8
+    # Trailing replicate / subgroup dims do not tile.
+    assert mem._shard_count(
+        "{devices=[2,1,4]<=[8] last_tile_dim_replicate}") == 2
+    assert mem._shard_count(
+        "{devices=[2,2,2]<=[8] last_tile_dims={manual, replicated}}") == 2
+    # Silent: replicated / maximal / absent keep the full tensor.
+    assert mem._shard_count(None) == 1
+    assert mem._shard_count("{replicated}") == 1
+    assert mem._shard_count("{maximal device=3}") == 1
+
+
+def test_donation_bytes_are_per_device_on_sharded_args():
+    """The StableHLO @main type is the GLOBAL shape while
+    memory_analysis() accounts per-device bytes; the donation table
+    must divide by the mhlo.sharding shard count or the alias discount
+    (and the pinned peak) is off by the mesh size on sharded programs —
+    the unit-mixing regression this PR's review caught."""
+    sharded = _SHLO_DONATE.replace(
+        '%arg0: tensor<8x8xf32> {tf.aliasing_output = 0 : i32}',
+        '%arg0: tensor<8x8xf32> {mhlo.sharding = "{devices=[8,1]<=[8]}",'
+        ' tf.aliasing_output = 0 : i32}')
+    attrs = mem.parse_arg_donations(sharded)
+    assert attrs[0]["sharding"] == "{devices=[8,1]<=[8]}"
+    table = mem.donation_table(
+        [True, False, False], attrs,
+        mem.parse_input_output_aliases(_HLO_ALIASED))
+    by_idx = {d.arg_index: d for d in table}
+    assert by_idx[0].shard_count == 8
+    assert by_idx[0].bytes == 256 // 8          # per-device, not global
+    # Silent: a replicated arg keeps its full size.
+    replicated = _SHLO_DONATE.replace(
+        '%arg0: tensor<8x8xf32> {tf.aliasing_output = 0 : i32}',
+        '%arg0: tensor<8x8xf32> {mhlo.sharding = "{replicated}",'
+        ' tf.aliasing_output = 0 : i32}')
+    table = mem.donation_table(
+        [True, False, False], mem.parse_arg_donations(replicated),
+        mem.parse_input_output_aliases(_HLO_ALIASED))
+    (d0,) = [d for d in table if d.arg_index == 0]
+    assert d0.shard_count == 1 and d0.bytes == 256
 
 
 # ---------------------------------------------------------------------------
@@ -566,6 +613,25 @@ def test_repo_manifests_clean_tier1():
     findings = mc.check_programs(list(sc.TIER1_PROGRAMS), d)
     live = _live(findings)
     assert not live, "\n".join(f.render() for f in live)
+
+
+def test_repo_manifest_pins_exact_tier1():
+    """observed == recomputed, not merely observed <= budget: the MC4xx
+    ceilings only catch drift UP, so a footprint that silently shrinks
+    (or an accounting change like the per-device donation fix) would
+    leave committed manifests stale while the gate stays green.  Exact
+    equality makes every drift a visible diff that either re-pins via
+    ``memcheck --update`` or reverts."""
+    d = mc.default_manifest_dir(_REPO_ROOT)
+    for nm in sc.TIER1_PROGRAMS:
+        committed = load_manifest(manifest_path(nm, d)).observed
+        recomputed = mc.memory_report_for(nm).to_json()
+        stale = {k for k in set(committed) | set(recomputed)
+                 if committed.get(k) != recomputed.get(k)}
+        assert not stale, (
+            f"{nm}: committed manifest is stale on {sorted(stale)} — "
+            f"run 'python tools/memcheck.py --update' and review the "
+            f"diff")
 
 
 def test_tier1_step_many_pins_nonzero_hoistable_conditioning():
