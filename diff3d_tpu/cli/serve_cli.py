@@ -82,7 +82,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="extra compiled schedules to serve beyond the "
                         "default, as 'kind:steps,...' (e.g. "
                         "'ddim:16,ancestral:256'); requests naming any "
-                        "other schedule get a typed 503 with this list")
+                        "other schedule get a typed 503 with this list. "
+                        "With --replicas N, prefix an entry with 'i@' to "
+                        "give it to replica i only (e.g. "
+                        "'0@ddim:8,ancestral:256' = distilled-student "
+                        "schedule on replica 0, ancestral everywhere) — "
+                        "the router places requests on a replica that "
+                        "compiled their schedule")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="in-process engine replicas behind the fleet "
+                        "router front door (default: config, 1 = plain "
+                        "single-engine service).  Sessions "
+                        "(payload 'session_id') pin to a replica; "
+                        "adds GET /fleet and router counters to "
+                        "GET /metrics")
     p.add_argument("--scan_chunks", type=int, default=1,
                    help="split each view's diffusion scan into this many "
                         "device executions (must divide the per-view "
@@ -103,7 +116,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def build_service(args):
-    """Config + params + sampler -> ServingService (not started)."""
+    """Config + params + sampler(s) -> ServingService (not started), or
+    a FleetService when --replicas > 1."""
     import dataclasses
 
     import jax
@@ -111,7 +125,7 @@ def build_service(args):
     from diff3d_tpu import config as config_lib
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.sampling import Sampler, record_capacity
-    from diff3d_tpu.serving import ServingService
+    from diff3d_tpu.serving import FleetService, ServingService
 
     cfg = {"srn64": config_lib.srn64_config,
            "srn128": config_lib.srn128_config,
@@ -122,7 +136,7 @@ def build_service(args):
                                                timesteps=args.steps))
     cfg = apply_model_width_overrides(cfg, args)
     over = {k: getattr(args, k) for k in
-            ("host", "port", "max_batch", "max_queue")
+            ("host", "port", "max_batch", "max_queue", "replicas")
             if getattr(args, k) is not None}
     if args.max_wait_ms is not None:
         over["max_wait_ms"] = args.max_wait_ms
@@ -163,33 +177,76 @@ def build_service(args):
     sampler = Sampler(model, params, cfg, scan_chunks=args.scan_chunks,
                       mesh=mesh_env, sampler_kind=args.sampler,
                       steps=args.sampler_steps)
+    n_replicas = cfg.serving.replicas
     extra_samplers = {}
+    per_replica_extra = {}
+    made = {}                  # one Sampler per distinct extra schedule
+
+    def _sampler_for(sched):
+        if sched not in made:
+            made[sched] = Sampler(
+                model, params, cfg, scan_chunks=args.scan_chunks,
+                mesh=mesh_env, sampler_kind=sched[0], steps=sched[1])
+        return made[sched]
+
     if args.schedules:
         for spec in args.schedules.split(","):
-            kind, _, steps_s = spec.strip().partition(":")
+            spec = spec.strip()
+            target, at, rest = spec.partition("@")
+            idx = None
+            if at:
+                try:
+                    idx = int(target)
+                except ValueError:
+                    raise SystemExit(
+                        f"--schedules entry {spec!r}: replica prefix "
+                        "must be an integer index ('i@kind:steps')")
+                if not 0 <= idx < n_replicas:
+                    raise SystemExit(
+                        f"--schedules entry {spec!r}: replica index "
+                        f"{idx} outside --replicas {n_replicas}")
+            else:
+                rest = spec
+            kind, _, steps_s = rest.partition(":")
             try:
                 sched = (kind, int(steps_s))
             except ValueError:
                 raise SystemExit(
-                    f"--schedules entry {spec!r}: expected 'kind:steps'")
+                    f"--schedules entry {spec!r}: expected "
+                    "'[i@]kind:steps'")
             if sched == (sampler.sampler_kind, sampler.steps):
                 continue                    # already the default sampler
-            extra_samplers[sched] = Sampler(
-                model, params, cfg, scan_chunks=args.scan_chunks,
-                mesh=mesh_env, sampler_kind=sched[0], steps=sched[1])
-    service = ServingService(sampler, cfg, params_version=version,
-                             extra_samplers=extra_samplers or None)
+            if idx is None:
+                extra_samplers[sched] = _sampler_for(sched)
+            else:
+                per_replica_extra.setdefault(idx, {})[sched] = (
+                    _sampler_for(sched))
+    if n_replicas > 1:
+        service = FleetService.build(
+            sampler, cfg, extra_samplers=extra_samplers or None,
+            per_replica_extra=per_replica_extra or None,
+            params_version=version)
+    else:
+        if per_replica_extra:
+            raise SystemExit(
+                "per-replica 'i@kind:steps' schedules require "
+                "--replicas > 1")
+        service = ServingService(sampler, cfg, params_version=version,
+                                 extra_samplers=extra_samplers or None)
     if args.warmup:
         from diff3d_tpu.serving import Bucket
 
         cap = record_capacity(cfg.serving.max_views)
-        for s in [sampler, *extra_samplers.values()]:
-            bucket = Bucket(cfg.model.H, cfg.model.W, cap,
-                            s.steps, s.sampler_kind)
-            secs = service.engine.programs.warmup(bucket,
-                                                  s.lane_multiple,
-                                                  s.w.shape[0])
-            logging.info("warmed bucket %s in %.1fs", tuple(bucket), secs)
+        engines = ([service.engine] if n_replicas == 1
+                   else [rep.engine for rep in service.replicas])
+        for eng in engines:
+            for s in eng.samplers.values():
+                bucket = Bucket(cfg.model.H, cfg.model.W, cap,
+                                s.steps, s.sampler_kind)
+                secs = eng.programs.warmup(bucket, s.lane_multiple,
+                                           s.w.shape[0])
+                logging.info("warmed bucket %s in %.1fs",
+                             tuple(bucket), secs)
     return service
 
 
@@ -200,9 +257,10 @@ def main(argv=None) -> None:
 
     service = build_service(args)
     service.start(serve_http=True)
+    fleet = " , GET /fleet" if hasattr(service, "fleet_snapshot") else ""
     logging.info("listening on http://%s:%d (POST /synthesize, "
-                 "GET /healthz, GET /metrics)",
-                 service.cfg.serving.host, service.port)
+                 "GET /healthz, GET /metrics%s)",
+                 service.cfg.serving.host, service.port, fleet)
 
     done = threading.Event()
 

@@ -252,6 +252,11 @@ class ServingConfig:
     # Watchdog respawns of a dead engine loop before giving up and
     # failing new submissions fast.
     engine_max_restarts: int = 3
+    # ---- fleet (serving/router.py) ----------------------------------
+    # In-process engine replicas behind the fleet router's front door
+    # (1 = single-replica ServingService, no router).  Each replica owns
+    # its own scheduler/engine/program cache; sessions pin to replicas.
+    replicas: int = 1
 
     def validate(self) -> None:
         if self.max_batch < 1:
@@ -290,6 +295,8 @@ class ServingConfig:
             raise ValueError(
                 f"engine_max_restarts={self.engine_max_restarts} must be "
                 ">= 0")
+        if self.replicas < 1:
+            raise ValueError(f"replicas={self.replicas} must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)

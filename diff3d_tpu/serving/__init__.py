@@ -7,7 +7,11 @@ device-executor engine drives the object-batched per-view scan and admits
 new requests *between* views (continuous batching at view granularity —
 3DiM's 256-step-per-view sampler makes per-request latency batch-bound,
 not step-bound), and a stdlib HTTP frontend exposes submit/poll, health
-and metrics endpoints.
+and metrics endpoints.  Above the single engine, the fleet router
+(``serving/router.py`` + ``serving/fleet.py``) runs N replicas behind one
+front door with session affinity (device-resident records never migrate),
+typed fleet backpressure, blue/green params rollout and schedule-aware
+placement.
 """
 
 from diff3d_tpu.serving.cache import (ParamsRegistry, ProgramCache,
@@ -15,20 +19,26 @@ from diff3d_tpu.serving.cache import (ParamsRegistry, ProgramCache,
 from diff3d_tpu.serving.engine import (Engine, EngineStopTimeout,
                                        HEALTH_DEGRADED, HEALTH_DRAINING,
                                        HEALTH_OK)
+from diff3d_tpu.serving.fleet import HEALTH_DEAD, Replica, build_fleet
 from diff3d_tpu.serving.metrics import MetricsRegistry
+from diff3d_tpu.serving.router import FleetService, Router
 from diff3d_tpu.serving.scheduler import (Bucket, EngineDraining,
                                           EngineOverloaded, EngineStepError,
-                                          EngineStopped, QueueFullError,
+                                          EngineStopped, FleetOverloaded,
+                                          QueueFullError, ReplicaDraining,
                                           RequestCancelled, RequestTimeout,
-                                          Scheduler, UnsupportedSchedule,
-                                          ViewRequest)
-from diff3d_tpu.serving.server import ServingService, make_http_server
+                                          Scheduler, SessionLost,
+                                          UnsupportedSchedule, ViewRequest)
+from diff3d_tpu.serving.server import (ServingService, build_request,
+                                       make_http_server)
 
 __all__ = [
     "Bucket", "Engine", "EngineDraining", "EngineOverloaded",
     "EngineStepError", "EngineStopTimeout", "EngineStopped",
-    "HEALTH_DEGRADED", "HEALTH_DRAINING", "HEALTH_OK", "MetricsRegistry",
-    "ParamsRegistry", "ProgramCache", "QueueFullError", "RequestCancelled",
-    "RequestTimeout", "ResultCache", "Scheduler", "ServingService",
-    "UnsupportedSchedule", "ViewRequest", "make_http_server",
+    "FleetOverloaded", "FleetService", "HEALTH_DEAD", "HEALTH_DEGRADED",
+    "HEALTH_DRAINING", "HEALTH_OK", "MetricsRegistry", "ParamsRegistry",
+    "ProgramCache", "QueueFullError", "Replica", "ReplicaDraining",
+    "RequestCancelled", "RequestTimeout", "ResultCache", "Router",
+    "Scheduler", "ServingService", "SessionLost", "UnsupportedSchedule",
+    "ViewRequest", "build_fleet", "build_request", "make_http_server",
 ]

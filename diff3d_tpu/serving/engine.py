@@ -256,6 +256,17 @@ class Engine:
         """Sorted ``"kind:steps"`` strings this replica can serve."""
         return sorted(f"{k[0]}:{k[1]}" for k in self.samplers)
 
+    def supports_schedule(self, sampler_kind: Optional[str] = None,
+                          steps: Optional[int] = None) -> bool:
+        """Would :meth:`submit` accept this ``(sampler_kind, steps)``?
+        ``None`` fields resolve to the replica default, mirroring submit
+        — the router's schedule-aware placement asks this before
+        choosing a replica."""
+        kind = (sampler_kind if sampler_kind is not None
+                else self.default_schedule[0])
+        steps = steps if steps is not None else self.default_schedule[1]
+        return (kind, None if steps is None else int(steps)) in self.samplers
+
     def submit(self, req: ViewRequest) -> ViewRequest:
         """Schedule a request (or answer it from the result cache).
 
@@ -359,6 +370,32 @@ class Engine:
         drained = not (self.scheduler.depth() or self._inflight_count())
         log.info("drain complete" if drained else "drain incomplete")
         return drained
+
+    def resume(self) -> None:
+        """Re-admit after :meth:`drain` (the blue/green rollout path):
+        lift the drain freeze and any degraded soft limit, and return
+        health to ``ok``.  In-flight state is untouched — drain already
+        emptied it."""
+        self.scheduler.unfreeze()
+        self.scheduler.clear_soft_limit()
+        with self._health_lock:
+            self._ok_streak = 0
+        self._set_health(HEALTH_OK)
+
+    def kill(self, exc: BaseException) -> None:
+        """Hard, non-blocking stop simulating replica death (chaos /
+        fleet-failover path).  Unlike :meth:`stop` there is no drain and
+        no join: the stop flag is set, queued requests are rejected by
+        the scheduler close, and in-flight requests resolve with ``exc``
+        (a typed retryable error) immediately — the loop and watchdog
+        threads exit at their next check.  Safe to call from any thread,
+        including the engine loop itself (a ``kill`` fault spec fires
+        mid-dispatch)."""
+        self._stop.set()
+        self.scheduler.close(reject_pending=True)
+        n = self._reject_inflight(exc)
+        log.warning("engine killed (%s); rejected %d in-flight requests",
+                    exc, n)
 
     @property
     def alive(self) -> bool:
@@ -469,6 +506,11 @@ class Engine:
     def _inflight_count(self) -> int:
         with self._inflight_lock:
             return len(self._inflight)
+
+    def inflight(self) -> int:
+        """Admitted-but-unresolved requests (public: the fleet router's
+        least-loaded placement reads queue depth + this)."""
+        return self._inflight_count()
 
     def _reject_inflight(self, exc: BaseException) -> int:
         with self._inflight_lock:

@@ -1,0 +1,247 @@
+"""Replica lifecycle for the multi-replica serving fleet.
+
+A :class:`Replica` is one self-contained serving engine — its own
+bounded :class:`~diff3d_tpu.serving.scheduler.Scheduler`,
+:class:`~diff3d_tpu.serving.engine.Engine` (device executor),
+:class:`~diff3d_tpu.serving.cache.ParamsRegistry`,
+:class:`~diff3d_tpu.serving.cache.ProgramCache`,
+:class:`~diff3d_tpu.serving.cache.ResultCache` and
+:class:`~diff3d_tpu.serving.metrics.MetricsRegistry` — under a stable
+name.  The router (``serving/router.py``) owns N of them behind one
+HTTP surface and routes *requests to state*: an object session's
+device-resident record (DESIGN.md §6b) lives on whichever replica
+served its first view, so every later view of that session must land
+there.  The replica therefore keeps the per-session record ledger
+(:meth:`Replica.session_records`) that the affinity contract is
+asserted against — one session appearing on two replicas' ledgers IS a
+record migration, and the tests treat it as a bug.
+
+Lifecycle::
+
+    start -> (drain -> swap_params -> resume)* -> stop
+                     \\-> kill                    (chaos path)
+
+``kill`` is abrupt and non-blocking — it simulates process death.  A
+killed replica reports health ``"dead"`` and never serves again: the
+router fails sessionless traffic over to the survivors and rejects the
+replica's orphaned sticky sessions with a typed
+:class:`~diff3d_tpu.serving.scheduler.SessionLost` naming the lost
+owner.
+
+Sharing one ``sampler`` object across replicas (the
+:func:`build_fleet` default) shares its jit cache, so the fleet pays
+one compile per program shape instead of N — replica isolation is at
+the scheduler/engine/record level, not the compiled-code level, which
+is exactly the in-process-fleet shape.  Each replica still owns its
+ProgramCache (per-replica program *stats*), scheduler and metrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from diff3d_tpu.config import Config
+from diff3d_tpu.serving.cache import (ParamsRegistry, ProgramCache,
+                                      ResultCache)
+from diff3d_tpu.serving.engine import Engine, EngineStopTimeout
+from diff3d_tpu.serving.metrics import MetricsRegistry
+from diff3d_tpu.serving.scheduler import (EngineStopped, Scheduler,
+                                          ViewRequest)
+
+log = logging.getLogger(__name__)
+
+#: Replica-level health state beyond the engine's ok|degraded|draining
+#: (DESIGN.md §7): a killed replica (or one whose worker thread is gone)
+#: is ``dead`` — terminal, never routed to again.
+HEALTH_DEAD = "dead"
+
+
+class Replica:
+    """One named engine replica: scheduler + engine + caches + metrics.
+
+    Thin by design — all serving behavior lives in the engine; the
+    replica adds the identity, the session record ledger, and the
+    drain/swap/resume/kill lifecycle the router composes.
+    """
+
+    def __init__(self, name: str, sampler, cfg: Config,
+                 extra_samplers: Optional[dict] = None,
+                 params_version: str = "v0"):
+        """``extra_samplers`` maps ``(sampler_kind, steps)`` to extra
+        Sampler instances (sharing ``sampler``'s params) — the
+        schedules this replica serves beyond the default sampler's own
+        (the PR 4 schedule registry, now per-replica so the router can
+        place 8-step-DDIM traffic on distilled-student replicas and
+        parity traffic on teacher replicas)."""
+        cfg.serving.validate()
+        self.name = str(name)
+        self.cfg = cfg
+        self.metrics = MetricsRegistry()
+        self.scheduler = Scheduler(
+            max_queue=cfg.serving.max_queue,
+            max_wait_s=cfg.serving.max_wait_ms / 1e3,
+            default_timeout_s=cfg.serving.default_timeout_s,
+            metrics=self.metrics)
+        self.registry = ParamsRegistry(sampler.params,
+                                       version=params_version)
+        samplers = {(getattr(sampler, "sampler_kind", None),
+                     getattr(sampler, "steps", None)): sampler,
+                    **(extra_samplers or {})}
+        self.engine = Engine(
+            sampler, self.scheduler, self.metrics, cfg.serving,
+            params_registry=self.registry,
+            result_cache=ResultCache(cfg.serving.result_cache_entries,
+                                     self.metrics),
+            program_cache=ProgramCache(
+                samplers if len(samplers) > 1 else sampler, self.metrics),
+            extra_samplers=extra_samplers)
+        self._lock = threading.Lock()
+        # Session record ledger: session_id -> requests served into that
+        # session's record on THIS replica.  The router's zero-migration
+        # contract is asserted against these counters.
+        self._session_records: Dict[str, int] = {}  # guarded-by: self._lock
+        self._killed = False  # guarded-by: self._lock
+        self._records_ctr = self.metrics.counter(
+            "replica_session_records_total",
+            "session-carrying requests served into this replica's records")
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Replica":
+        self.engine.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        try:
+            self.engine.stop(timeout=timeout)
+        except EngineStopTimeout:
+            # The worker thread is leaked (wedged in a device call); the
+            # fleet keeps shutting the other replicas down — one wedged
+            # replica must not leak its siblings too.
+            log.error("replica %s: worker thread leaked on stop",
+                      self.name)
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        """Stop admissions, wait for queued + in-flight work (the
+        blue/green rollout step).  New submissions get EngineDraining;
+        the router additionally turns the session-sticky ones into
+        :class:`~diff3d_tpu.serving.scheduler.ReplicaDraining` before
+        they reach the scheduler."""
+        return self.engine.drain(timeout=timeout)
+
+    def resume(self) -> None:
+        """Re-admit after a drain (rollout complete for this replica)."""
+        self.engine.resume()
+
+    def kill(self, reason: str = "killed") -> None:
+        """Simulate replica death: non-blocking, idempotent.  In-flight
+        and queued requests resolve with typed retryable errors; the
+        replica reports ``dead`` forever after.  Device-resident records
+        die with it — the router owns telling sessions so."""
+        with self._lock:
+            if self._killed:
+                return
+            self._killed = True
+        log.warning("replica %s: killed (%s)", self.name, reason)
+        self.engine.kill(EngineStopped(
+            f"replica {self.name} {reason}: in-flight work lost"))
+
+    # -- state the router reads ------------------------------------------
+
+    @property
+    def health(self) -> str:
+        """``ok|degraded|draining`` from the engine, or ``dead`` once
+        killed / the worker thread is gone for good."""
+        with self._lock:
+            if self._killed:
+                return HEALTH_DEAD
+        return self.engine.health if self.engine.alive else HEALTH_DEAD
+
+    def depth(self) -> int:
+        """Load proxy for least-loaded placement: queued + in-flight."""
+        return self.scheduler.depth() + self.engine.inflight()
+
+    def supports(self, sampler_kind: Optional[str] = None,
+                 steps: Optional[int] = None) -> bool:
+        return self.engine.supports_schedule(sampler_kind, steps)
+
+    def supported_schedules(self) -> List[str]:
+        return self.engine.supported_schedules()
+
+    @property
+    def params_version(self) -> str:
+        return self.registry.version
+
+    # -- request path ----------------------------------------------------
+
+    def submit(self, req: ViewRequest) -> ViewRequest:
+        """Engine submit + session-record accounting.  The ledger counts
+        only *accepted* requests — a rejected submit leaves no trace, so
+        a failed first view does not pin the session here."""
+        req = self.engine.submit(req)
+        if req.session_id is not None:
+            with self._lock:
+                self._session_records[req.session_id] = (
+                    self._session_records.get(req.session_id, 0) + 1)
+            self._records_ctr.inc()
+        return req
+
+    def session_records(self) -> Dict[str, int]:
+        """Copy of the session -> served-request-count ledger."""
+        with self._lock:
+            return dict(self._session_records)
+
+    def session_count(self, session_id: str) -> int:
+        with self._lock:
+            return self._session_records.get(session_id, 0)
+
+    # -- rollout ---------------------------------------------------------
+
+    def swap_params(self, params, version: Optional[str] = None) -> str:
+        """Hot-swap this replica's params (serving/cache.py swap path);
+        returns the new version string.  Callers drain first if they
+        need no request to straddle two versions (the router's rollout
+        does); the swap itself is safe mid-flight — the engine reads
+        ``registry.current()`` once per view step."""
+        return self.registry.swap(params, version)
+
+    def snapshot(self) -> dict:
+        """Per-replica block of ``GET /fleet``."""
+        return {
+            "name": self.name,
+            "health": self.health,
+            "queue_depth": self.scheduler.depth(),
+            "inflight": self.engine.inflight(),
+            "params_version": self.registry.version,
+            "supported_schedules": self.supported_schedules(),
+            "sessions": len(self.session_records()),
+            "session_records_total": sum(
+                self.session_records().values()),
+            "engine_restarts": self.engine._restarts,
+        }
+
+
+def build_fleet(sampler, cfg: Config, n: Optional[int] = None,
+                extra_samplers: Optional[dict] = None,
+                per_replica_extra: Optional[Dict[int, dict]] = None,
+                params_version: str = "v0",
+                name_prefix: str = "r") -> List[Replica]:
+    """Build ``n`` replicas (default ``cfg.serving.replicas``) sharing
+    one sampler object (one jit cache -> one compile per program across
+    the fleet).  ``extra_samplers`` applies to every replica;
+    ``per_replica_extra[i]`` adds replica-``i``-only schedules — the
+    heterogeneous-fleet shape (e.g. one distilled-student replica in a
+    teacher fleet)."""
+    n = cfg.serving.replicas if n is None else int(n)
+    if n < 1:
+        raise ValueError(f"fleet size {n} must be >= 1")
+    replicas = []
+    for i in range(n):
+        extra = dict(extra_samplers or {})
+        extra.update((per_replica_extra or {}).get(i, {}))
+        replicas.append(Replica(f"{name_prefix}{i}", sampler, cfg,
+                                extra_samplers=extra or None,
+                                params_version=params_version))
+    return replicas

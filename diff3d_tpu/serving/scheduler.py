@@ -104,6 +104,42 @@ class UnsupportedSchedule(RetryableError):
         self.supported = list(supported or [])
 
 
+# Fleet-level typed rejections (serving/router.py).  Same taxonomy, one
+# level up: the *fleet*, not a single replica, could not place the
+# request right now.
+
+class FleetOverloaded(RetryableError):
+    """No eligible replica can admit the request: every replica that
+    serves the schedule is full, degraded past its soft limit, draining,
+    or dead.  Purely a capacity signal — retry the same request after
+    ``retry_after_s``."""
+
+
+class ReplicaDraining(RetryableError):
+    """The session's owning replica is draining (blue/green rollout).
+    The device-resident record stays where it is — the session must NOT
+    be restarted elsewhere; retry the same session after
+    ``retry_after_s`` and it will land on the re-admitted replica."""
+
+    def __init__(self, msg: str, *, replica: Optional[str] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg, retry_after_s=retry_after_s)
+        self.replica = replica
+
+
+class SessionLost(RetryableError):
+    """The session's owning replica is gone (killed/dead), and the
+    device-resident record died with it.  ``replica`` names the lost
+    owner.  Retryable in the *session* sense: the client restarts the
+    session from its committed views — a bare resubmit of view N would
+    condition on state that no longer exists anywhere."""
+
+    def __init__(self, msg: str, *, replica: Optional[str] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg, retry_after_s=retry_after_s)
+        self.replica = replica
+
+
 _req_ids = itertools.count()
 
 
@@ -122,6 +158,13 @@ class ViewRequest:
     ``None`` means "replica default" and is resolved by the engine at
     submit time (:meth:`resolve_schedule`) — a request never queues with
     an unresolved schedule.
+
+    ``session_id`` names the object session this request extends (router
+    affinity key, DESIGN.md §14): all requests carrying the same
+    session_id must run on the replica holding the session's
+    device-resident record.  ``None`` = sessionless (any replica).  The
+    id does not enter :meth:`content_key` — identical inputs produce
+    identical results whichever session asked.
     """
 
     def __init__(self, views: dict, seed: int = 0,
@@ -129,7 +172,8 @@ class ViewRequest:
                  timeout_s: Optional[float] = None,
                  request_id: Optional[str] = None,
                  sampler_kind: Optional[str] = None,
-                 steps: Optional[int] = None):
+                 steps: Optional[int] = None,
+                 session_id: Optional[str] = None):
         imgs = np.asarray(views["imgs"], np.float32)
         R = np.asarray(views["R"], np.float32)
         T = np.asarray(views["T"], np.float32)
@@ -167,6 +211,7 @@ class ViewRequest:
                 raise ValueError(f"steps={steps} must be >= 1")
         self.sampler_kind = sampler_kind
         self.steps = steps
+        self.session_id = None if session_id is None else str(session_id)
         H, W = imgs.shape[1:3]
         self._HW = (H, W)
         self.bucket = Bucket(H, W, record_capacity(self.n_views),

@@ -22,6 +22,9 @@ Surface:
   * ``GET /stats`` — the structured snapshot (alias of
     ``/metrics?format=json``): per-bucket program-cache entries carry
     their step count and sampler kind.
+  * ``GET /fleet`` — fleet topology + per-replica health/depth/sessions
+    (404 on a single-replica service; served when the front door is the
+    router's :class:`~diff3d_tpu.serving.router.FleetService`).
 
 Backpressure maps to status codes, never to silent queuing: a full queue
 is ``429``, a request deadline is ``504``, a cancelled request ``409``,
@@ -71,6 +74,42 @@ def _error_status(exc: BaseException) -> int:
 def _retry_after(exc: BaseException) -> Optional[int]:
     after = getattr(exc, "retry_after_s", None)
     return max(1, int(round(after))) if after else None
+
+
+def build_request(payload: dict, cfg: Config) -> ViewRequest:
+    """Validate a JSON-shaped payload against the served model and build
+    the :class:`ViewRequest`.  Shared by the single-replica
+    :class:`ServingService` and the fleet router's front door — both
+    enforce the same ceilings before any replica is chosen."""
+    if "views" not in payload:
+        raise ValueError("payload must carry a 'views' object with "
+                         "imgs/R/T/K")
+    n_views = payload.get("n_views")
+    if n_views is not None:
+        n_views = int(n_views)
+        if n_views > cfg.serving.max_views:
+            raise ValueError(
+                f"n_views={n_views} exceeds the service ceiling "
+                f"{cfg.serving.max_views}")
+    steps = payload.get("steps")
+    req = ViewRequest(
+        {k: np.asarray(v) for k, v in payload["views"].items()},
+        seed=int(payload.get("seed", 0)),
+        n_views=n_views,
+        timeout_s=payload.get("timeout_s"),
+        sampler_kind=payload.get("sampler_kind"),
+        steps=None if steps is None else int(steps),
+        session_id=payload.get("session_id"))
+    if req.n_views > cfg.serving.max_views:
+        raise ValueError(
+            f"request spans {req.n_views} views, service ceiling is "
+            f"{cfg.serving.max_views} (pass n_views to truncate)")
+    H, W = req.bucket.H, req.bucket.W
+    if (H, W) != (cfg.model.H, cfg.model.W):
+        raise ValueError(
+            f"image size {H}x{W} does not match the served model "
+            f"({cfg.model.H}x{cfg.model.W})")
+    return req
 
 
 class ServingService:
@@ -150,33 +189,7 @@ class ServingService:
 
     def submit(self, payload: dict) -> ViewRequest:
         """Build + schedule a request from a JSON-shaped payload."""
-        if "views" not in payload:
-            raise ValueError("payload must carry a 'views' object with "
-                             "imgs/R/T/K")
-        n_views = payload.get("n_views")
-        if n_views is not None:
-            n_views = int(n_views)
-            if n_views > self.cfg.serving.max_views:
-                raise ValueError(
-                    f"n_views={n_views} exceeds the service ceiling "
-                    f"{self.cfg.serving.max_views}")
-        steps = payload.get("steps")
-        req = ViewRequest(
-            {k: np.asarray(v) for k, v in payload["views"].items()},
-            seed=int(payload.get("seed", 0)),
-            n_views=n_views,
-            timeout_s=payload.get("timeout_s"),
-            sampler_kind=payload.get("sampler_kind"),
-            steps=None if steps is None else int(steps))
-        if req.n_views > self.cfg.serving.max_views:
-            raise ValueError(
-                f"request spans {req.n_views} views, service ceiling is "
-                f"{self.cfg.serving.max_views} (pass n_views to truncate)")
-        H, W = req.bucket.H, req.bucket.W
-        if (H, W) != (self.cfg.model.H, self.cfg.model.W):
-            raise ValueError(
-                f"image size {H}x{W} does not match the served model "
-                f"({self.cfg.model.H}x{self.cfg.model.W})")
+        req = build_request(payload, self.cfg)
         self.engine.submit(req)
         with self._requests_lock:
             self._requests[req.id] = req
@@ -269,6 +282,16 @@ def make_http_server(service: ServingService, host: str,
             elif url.path == "/stats":
                 self._send_json(
                     200, service.metrics_snapshot(include_memory=True))
+            elif url.path == "/fleet":
+                # Served only by the fleet router's front door
+                # (serving/router.py FleetService, duck-typed into this
+                # handler); the single-replica service has no fleet.
+                snap = getattr(service, "fleet_snapshot", None)
+                if snap is None:
+                    self._send_json(
+                        404, {"error": "not a fleet front door"})
+                else:
+                    self._send_json(200, snap())
             elif url.path.startswith("/result/"):
                 req = service.get_request(url.path[len("/result/"):])
                 if req is None:

@@ -20,9 +20,17 @@ Fault kinds:
   retryable error, so injected faults flow through the same
   classification as real transient faults).
 * ``"slow"``    — sleep ``delay_s`` before proceeding (drives watchdog
-  stuck-step detection).
+  stuck-step detection; a sleep past the watchdog budget is the
+  "wedged replica" fault).
 * ``"sigterm"`` — deliver a real ``SIGTERM`` to this process's main
   thread (drives the trainer's preemption path end-to-end).
+* ``"kill"``    — invoke the kill hook registered for the site
+  (:meth:`FaultInjector.set_kill_hook`) and then raise, aborting the
+  dispatch that fired it.  This is replica death for the fleet router:
+  :func:`arm_replica` instruments a fleet replica so every view-step
+  dispatch fires ``replica.<name>.step`` and registers
+  ``Replica.kill`` as that site's kill hook — a ``kill`` spec then
+  takes the replica down mid-run, in-flight work and all.
 """
 
 from __future__ import annotations
@@ -57,7 +65,7 @@ class FaultSpec:
     ``max_fires`` caps total firings of this spec.
     """
 
-    kind: str = "error"                       # "error" | "slow" | "sigterm"
+    kind: str = "error"              # "error" | "slow" | "sigterm" | "kill"
     first_n: int = 0
     at_calls: Tuple[int, ...] = ()
     prob: float = 0.0
@@ -67,7 +75,7 @@ class FaultSpec:
     fires: int = 0                            # bookkeeping, not config
 
     def __post_init__(self):
-        if self.kind not in ("error", "slow", "sigterm"):
+        if self.kind not in ("error", "slow", "sigterm", "kill"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if not 0.0 <= self.prob <= 1.0:
             raise ValueError(f"prob must be in [0, 1], got {self.prob}")
@@ -81,6 +89,10 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._specs: Dict[str, List[FaultSpec]] = collections.defaultdict(list)
         self._rngs: Dict[str, random.Random] = {}
+        # Per-site kill hooks ("kill" specs invoke them); see
+        # set_kill_hook / arm_replica.
+        self._kill_hooks: Dict[str, Callable[[], None]] = (
+            {})  # guarded-by: self._lock
         self.calls: collections.Counter = collections.Counter()
         self.fired: collections.Counter = collections.Counter()
 
@@ -104,6 +116,20 @@ class FaultInjector:
                 self._specs.clear()
             else:
                 self._specs.pop(site, None)
+
+    def set_kill_hook(self, site: str,
+                      hook: Callable[[], None]) -> None:
+        """Register the destructive action a ``"kill"`` spec at ``site``
+        performs (e.g. ``Replica.kill``).  The hook runs on the thread
+        that fired the site — for a replica that is its own engine
+        loop, which is exactly what real mid-dispatch death looks
+        like."""
+        with self._lock:
+            self._kill_hooks[site] = hook
+
+    def _kill_hook_for(self, site: str) -> Optional[Callable[[], None]]:
+        with self._lock:
+            return self._kill_hooks.get(site)
 
     def _rng_for(self, site: str) -> random.Random:
         rng = self._rngs.get(site)
@@ -136,6 +162,20 @@ class FaultInjector:
             if spec.kind == "slow":
                 log.info("fault[%s]: sleeping %.2fs (call %d)", site, spec.delay_s, n)
                 time.sleep(spec.delay_s)
+            elif spec.kind == "kill":
+                hook = self._kill_hook_for(site)
+                if hook is None:
+                    raise RuntimeError(
+                        f"kill spec fired at {site!r} but no kill hook "
+                        "is registered (set_kill_hook / arm_replica)")
+                log.info("fault[%s]: invoking kill hook (call %d)",
+                         site, n)
+                hook()
+                # Abort the dispatch that fired us: the killed target's
+                # in-flight work is already rejected; letting this call
+                # run to completion would resurrect it.
+                raise FaultInjected(
+                    f"killed at {site} (call {n})")
             elif spec.kind == "sigterm":
                 log.info("fault[%s]: delivering SIGTERM (call %d)", site, n)
                 # Target the main thread explicitly.  os.kill() lets the
@@ -187,3 +227,33 @@ class _FaultySampler:
 def wrap_sampler(sampler, injector: FaultInjector, site: str = "engine.step"):
     """Wrap a sampler so every ``step_many`` dispatch fires ``site``."""
     return _FaultySampler(sampler, injector, site)
+
+
+def replica_site(name: str) -> str:
+    """The named fault site of one fleet replica's view-step dispatch."""
+    return f"replica.{name}.step"
+
+
+def arm_replica(replica, injector: FaultInjector) -> str:
+    """Instrument one fleet replica for chaos and return its site name.
+
+    Every view-step dispatch of ``replica`` (any schedule — the hook
+    sits on its ProgramCache, below the per-schedule samplers) fires
+    ``replica.<name>.step``; specs registered there then mean:
+
+    * ``kind="slow", delay_s=...`` — a slow replica (past the watchdog
+      budget: a wedged one);
+    * ``kind="error"``             — a faulting replica (degrades);
+    * ``kind="kill"``              — replica death mid-dispatch:
+      ``Replica.kill`` runs, in-flight and queued requests resolve with
+      typed retryable errors, and the replica reports ``dead``.
+
+    Post-hoc instrumentation (no build-time sampler wrapping), so one
+    fleet can arm each replica under its own name even when the
+    replicas share a sampler object.
+    """
+    site = replica_site(replica.name)
+    programs = replica.engine.programs
+    programs.step_many = injector.wrap(site, programs.step_many)
+    injector.set_kill_hook(site, replica.kill)
+    return site

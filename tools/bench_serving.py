@@ -14,6 +14,12 @@ microbatcher amortises the compiled scan across requests (occupancy ->
 max_batch) and throughput climbs at bounded latency cost until the queue
 saturates.  A fresh service per rate keeps the metrics windows clean.
 
+With ``--replicas N`` the sweep runs against the fleet router
+(``serving/router.py``) instead of a bare engine: sessionless requests
+take the least-loaded path, and each rate point additionally reports
+per-replica view counts and the utilization skew (hottest replica /
+even-split share; 1.0 = perfectly balanced).
+
 Usage (CPU smoke):
     JAX_PLATFORMS=cpu python tools/bench_serving.py --config test \
         --rates 2,8,32 --requests 12 --out runs/bench_serving.json
@@ -88,29 +94,60 @@ def _synthetic_views(n_views: int, size: int, seed: int):
     }
 
 
+def _aggregate_snaps(snaps):
+    """Sum counters / count-weight histogram means across replica
+    metric snapshots (one replica = the single-service case)."""
+    counters, hists = {}, {}
+    for snap in snaps:
+        for k, v in snap["counters"].items():
+            counters[k] = counters.get(k, 0) + v
+        for k, h in snap["histograms"].items():
+            agg = hists.setdefault(k, {"count": 0, "_wsum": 0.0,
+                                       "p50": 0.0})
+            n = h.get("count", 0)
+            agg["count"] += n
+            agg["_wsum"] += h.get("mean", 0.0) * n
+            agg["p50"] = max(agg["p50"], h.get("p50", 0.0))
+    for h in hists.values():
+        h["mean"] = h["_wsum"] / h["count"] if h["count"] else 0.0
+    return counters, hists
+
+
 def _run_rate(sampler, cfg, rate: float, args) -> dict:
     import numpy as np
 
-    from diff3d_tpu.serving import ServingService
+    from diff3d_tpu.serving import FleetService, ServingService
 
-    service = ServingService(sampler, cfg).start(serve_http=False)
+    fleet = args.replicas > 1
+    if fleet:
+        service = FleetService.build(sampler, cfg, n=args.replicas)
+        service.start(serve_http=False)
+        replicas = service.replicas
+        engines = [rep.engine for rep in replicas]
+        submit = service.router.submit
+    else:
+        service = ServingService(sampler, cfg).start(serve_http=False)
+        replicas = None
+        engines = [service.engine]
+        submit = service.engine.submit
     views = [_synthetic_views(args.n_views, cfg.model.H, i)
              for i in range(args.requests)]
     # Warm the fullest lane count so rate 0's first request doesn't pay
     # the compile (every rate would otherwise time one compile each).
     # Lane counts go through the engine's rounding (power of two, then up
     # to the mesh's lane multiple) so the warmed shapes are exactly the
-    # ones traffic will launch.
+    # ones traffic will launch.  Fleet replicas share the sampler's jit
+    # cache, so only the first replica's warmup compiles.
     from diff3d_tpu.sampling import record_capacity
     from diff3d_tpu.serving import Bucket
     from diff3d_tpu.serving.engine import lane_count
     bucket = Bucket(cfg.model.H, cfg.model.W, record_capacity(args.n_views),
                     sampler.steps, sampler.sampler_kind)
-    eng = service.engine
-    for lanes in {lane_count(1, eng.max_batch, eng.lane_multiple),
-                  lane_count(min(eng.max_batch, args.requests or 1),
-                             eng.max_batch, eng.lane_multiple)}:
-        service.engine.programs.warmup(bucket, lanes, sampler.w.shape[0])
+    for eng in engines:
+        for lanes in {lane_count(1, eng.max_batch, eng.lane_multiple),
+                      lane_count(min(eng.max_batch, args.requests or 1),
+                                 eng.max_batch, eng.lane_multiple)}:
+            eng.programs.warmup(bucket, lanes, sampler.w.shape[0])
 
     from diff3d_tpu.serving.scheduler import ViewRequest
     reqs, latencies, errors = [], [], []
@@ -130,7 +167,7 @@ def _run_rate(sampler, cfg, rate: float, args) -> dict:
     for i in range(args.requests):
         req = ViewRequest(views[i], seed=i, n_views=args.n_views)
         try:
-            service.engine.submit(req)
+            submit(req)
         except Exception as e:
             errors.append(str(e))
             continue
@@ -143,18 +180,28 @@ def _run_rate(sampler, cfg, rate: float, args) -> dict:
     for w in waiters:
         w.join()
     wall = time.perf_counter() - t0
-    snap = service.metrics_snapshot()
+    if fleet:
+        per_replica_views = {
+            rep.name: rep.metrics.snapshot()["counters"].get(
+                "serving_views_completed_total", 0) for rep in replicas}
+        counters, hists = _aggregate_snaps(
+            [rep.metrics.snapshot() for rep in replicas])
+        router_snap = service.metrics_snapshot()["counters"]
+    else:
+        per_replica_views, router_snap = None, {}
+        snap = service.metrics_snapshot()
+        counters, hists = snap["counters"], snap["histograms"]
     service.stop()
 
     lat = np.asarray(sorted(latencies)) if latencies else np.zeros(0)
-    views_done = snap["counters"].get("serving_views_completed_total", 0)
-    occ = snap["histograms"].get("serving_batch_occupancy", {})
-    padf = snap["histograms"].get("serving_batch_padding_fraction", {})
-    up_bytes = snap["counters"].get("serving_host_upload_bytes_total", 0)
-    fetch_bytes = snap["counters"].get("serving_host_fetch_bytes_total", 0)
-    return {
-        "chips_used": service.engine.lane_multiple,
-        "lane_multiple": service.engine.lane_multiple,
+    views_done = counters.get("serving_views_completed_total", 0)
+    occ = hists.get("serving_batch_occupancy", {})
+    padf = hists.get("serving_batch_padding_fraction", {})
+    up_bytes = counters.get("serving_host_upload_bytes_total", 0)
+    fetch_bytes = counters.get("serving_host_fetch_bytes_total", 0)
+    point = {
+        "chips_used": engines[0].lane_multiple,
+        "lane_multiple": engines[0].lane_multiple,
         "host_upload_bytes_per_view": (round(up_bytes / views_done)
                                        if views_done else None),
         "host_fetch_bytes_per_view": (round(fetch_bytes / views_done)
@@ -172,9 +219,25 @@ def _run_rate(sampler, cfg, rate: float, args) -> dict:
                           if lat.size else None),
         "occupancy_mean": round(occ.get("mean", 0.0), 3),
         "padding_fraction_mean": round(padf.get("mean", 0.0), 3),
-        "ttfv_p50_s": round(snap["histograms"].get(
+        "ttfv_p50_s": round(hists.get(
             "serving_time_to_first_view_seconds", {}).get("p50", 0.0), 3),
     }
+    if fleet:
+        vals = list(per_replica_views.values())
+        mean = sum(vals) / len(vals) if vals else 0.0
+        point.update({
+            "replicas": args.replicas,
+            "per_replica_views": per_replica_views,
+            # Utilization skew: hottest replica's share of a perfectly
+            # even split (1.0 = balanced; R = everything on one of R).
+            "utilization_skew": (round(max(vals) / mean, 3)
+                                 if mean else None),
+            "router_failover_total": router_snap.get(
+                "router_failover_total", 0),
+            "router_rejected_total": router_snap.get(
+                "router_rejected_total", 0),
+        })
+    return point
 
 
 def main(argv=None) -> int:
@@ -205,6 +268,11 @@ def main(argv=None) -> int:
     p.add_argument("--mesh", action="store_true",
                    help="shard the sampler over cfg.mesh (lane counts "
                         "round up to the data-axis size)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="run the sweep against the fleet router over "
+                        "this many in-process replicas (sessionless "
+                        "least-loaded placement); reports "
+                        "per_replica_views + utilization_skew per rate")
     p.add_argument("--out", default="runs/bench_serving.json")
     args = p.parse_args(argv)
 
@@ -234,6 +302,7 @@ def main(argv=None) -> int:
         "n_views": args.n_views,
         "max_batch": args.max_batch,
         "max_wait_ms": args.max_wait_ms,
+        "replicas": args.replicas,
         "points": points,
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
