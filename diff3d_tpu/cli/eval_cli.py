@@ -144,6 +144,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save_dir", default=None,
                    help="dump gt/generated view PNGs here "
                         "(<obj>/view{V}_{gt,gen}.png)")
+    p.add_argument("--orbit", type=int, default=0,
+                   help="ALSO render an N-frame orbit turntable per "
+                        "--orbit_objects eval object (radius/elevation "
+                        "derived from its GT poses) and report the "
+                        "multi-view reprojection-consistency metric "
+                        "(orbit_consistency in the output JSON); with "
+                        "--save_dir the frames land in "
+                        "<obj>/orbit/frame_%%03d.png + a contact sheet")
+    p.add_argument("--orbit_objects", type=int, default=1,
+                   help="eval objects to render orbits for (first K)")
     return p
 
 
@@ -550,6 +560,72 @@ def main(argv=None) -> None:
                     "objects_above_baseline", "ssim", fid_key):
             record[f"{key}_w_selected"] = sel_agg[key]
         record["per_object_w_selected"] = sel_agg["per_object"]
+
+    # Orbit turntables + 3D-consistency readout: the trajectory-service
+    # workload, scored offline.  Radius/elevation come from each
+    # object's own GT poses so the orbit stays on the data manifold the
+    # model was trained on; frames are synthesised autoregressively
+    # (same record contract as serving's TrajectoryRequest), then scored
+    # with the plane-homography reprojection metric.
+    if args.orbit:
+        from diff3d_tpu.evaluation import reprojection_consistency
+        from diff3d_tpu.trajectory import orbit_path, trajectory_views
+
+        if args.orbit < 2:
+            raise SystemExit("--orbit needs >= 2 frames to score "
+                             "consistency")
+        per_orbit = []
+        for obj in eval_objs[: args.orbit_objects]:
+            views = obj_views[obj]
+            T_gt = np.asarray(views["T"], np.float64)
+            radii = np.linalg.norm(T_gt, axis=-1)
+            radius = float(radii.mean())
+            elevation = float(np.rad2deg(np.arcsin(
+                np.clip(T_gt[:, 2] / np.maximum(radii, 1e-9),
+                        -1.0, 1.0)).mean()))
+            path_R, path_T = orbit_path(args.orbit, radius=radius,
+                                        elevation_deg=elevation)
+            tviews = trajectory_views(views["imgs"][0], views["R"][0],
+                                      views["T"][0], views["K"],
+                                      path_R, path_T)
+            # synthesize sizes the record from imgs.shape[0]: tile the
+            # conditioning image across the path (only imgs[0] is read).
+            tviews["imgs"] = np.broadcast_to(
+                tviews["imgs"][:1], (args.orbit + 1,) +
+                tviews["imgs"].shape[1:])
+            rng, k = jax.random.split(rng)
+            frames = sampler.synthesize(tviews, k)  # [N, B, H, W, 3]
+            gen = frames[:, args.w_index].astype(np.float32)
+            score = reprojection_consistency(gen, path_R, path_T,
+                                             views["K"])
+            entry = {"id": str(obj), "radius": round(radius, 3),
+                     "elevation_deg": round(elevation, 2),
+                     "consistency_l1": score["consistency_l1"],
+                     "consistency_psnr": score["consistency_psnr"],
+                     "valid_frac": round(score["valid_frac"], 4)}
+            if args.save_dir:
+                from diff3d_tpu.utils import save_frame_sequence
+
+                art = save_frame_sequence(
+                    os.path.join(args.save_dir, str(obj), "orbit"), gen)
+                entry["frames_dir"] = art["dir"]
+                logging.info("orbit frames for %s -> %s", obj,
+                             art["dir"])
+            per_orbit.append(entry)
+        l1s = [o["consistency_l1"] for o in per_orbit
+               if o["consistency_l1"] is not None]
+        ps = [o["consistency_psnr"] for o in per_orbit
+              if o["consistency_psnr"] is not None]
+        record["orbit_consistency"] = {
+            "frames": args.orbit,
+            "objects": len(per_orbit),
+            "w_index": args.w_index,
+            "consistency_l1": (round(float(np.mean(l1s)), 5)
+                               if l1s else None),
+            "consistency_psnr": (round(float(np.mean(ps)), 3)
+                                 if ps else None),
+            "per_object": per_orbit,
+        }
 
     if args.save_dir:
         from PIL import Image

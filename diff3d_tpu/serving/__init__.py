@@ -28,8 +28,10 @@ from diff3d_tpu.serving.scheduler import (Bucket, EngineDraining,
                                           QueueFullError, ReplicaDraining,
                                           RequestCancelled, RequestTimeout,
                                           Scheduler, SessionLost,
+                                          TrajectoryRequest,
                                           UnsupportedSchedule, ViewRequest)
 from diff3d_tpu.serving.server import (ServingService, build_request,
+                                       build_trajectory_request,
                                        make_http_server)
 
 __all__ = [
@@ -39,6 +41,7 @@ __all__ = [
     "HEALTH_DRAINING", "HEALTH_OK", "MetricsRegistry", "ParamsRegistry",
     "ProgramCache", "QueueFullError", "Replica", "ReplicaDraining",
     "RequestCancelled", "RequestTimeout", "ResultCache", "Router",
-    "Scheduler", "ServingService", "SessionLost", "UnsupportedSchedule",
-    "ViewRequest", "build_fleet", "build_request", "make_http_server",
+    "Scheduler", "ServingService", "SessionLost", "TrajectoryRequest",
+    "UnsupportedSchedule", "ViewRequest", "build_fleet", "build_request",
+    "build_trajectory_request", "make_http_server",
 ]

@@ -217,6 +217,15 @@ class Engine:
             "serving_unsupported_schedule_total",
             "submissions naming a (sampler_kind, steps) with no "
             "compiled bucket")
+        self._traj_requests = m.counter(
+            "serving_trajectory_requests_total",
+            "trajectory (camera-path) requests accepted for scheduling")
+        self._traj_frames = m.counter(
+            "serving_trajectory_frames_total",
+            "trajectory frames committed to records")
+        self._traj_active_g = m.gauge(
+            "serving_active_trajectories",
+            "trajectory requests admitted but not yet resolved")
         self._health_g = m.gauge(
             "serving_engine_health",
             "engine health (0=ok, 1=degraded, 2=draining)")
@@ -299,6 +308,8 @@ class Engine:
             req._resolve(hit)
             return req
         self._submitted.inc()
+        if req.is_trajectory:
+            self._traj_requests.inc()
         return self.scheduler.submit(req)
 
     def start(self) -> "Engine":
@@ -430,6 +441,7 @@ class Engine:
                 "default_schedule": (
                     f"{self.default_schedule[0]}:{self.default_schedule[1]}"),
                 "supported_schedules": self.supported_schedules(),
+                "trajectories": self.trajectory_progress(),
             }
         }
 
@@ -498,10 +510,14 @@ class Engine:
     def _register(self, req: ViewRequest) -> None:
         with self._inflight_lock:
             self._inflight[req.id] = req
+            self._traj_active_g.set(sum(
+                1 for r in self._inflight.values() if r.is_trajectory))
 
     def _unregister(self, req: ViewRequest) -> None:
         with self._inflight_lock:
             self._inflight.pop(req.id, None)
+            self._traj_active_g.set(sum(
+                1 for r in self._inflight.values() if r.is_trajectory))
 
     def _inflight_count(self) -> int:
         with self._inflight_lock:
@@ -511,6 +527,21 @@ class Engine:
         """Admitted-but-unresolved requests (public: the fleet router's
         least-loaded placement reads queue depth + this)."""
         return self._inflight_count()
+
+    def trajectory_progress(self) -> List[dict]:
+        """Per-trajectory progress of admitted-but-unresolved trajectory
+        requests, for ``/metrics`` (engine block) and the per-replica
+        ``/fleet`` snapshot.  frames_done reads each request's own
+        monotonic frame buffer — no engine state is touched, so this is
+        safe from any thread."""
+        with self._inflight_lock:
+            trajs = [r for r in self._inflight.values() if r.is_trajectory]
+        return [{
+            "id": r.id,
+            "session_id": r.session_id,
+            "frames_done": r.frames_done(),
+            "n_frames": r.n_frames,
+        } for r in trajs]
 
     def _reject_inflight(self, exc: BaseException) -> int:
         with self._inflight_lock:
@@ -688,6 +719,13 @@ class Engine:
             if slot.req.first_view_time is None:
                 slot.req.first_view_time = now
                 self._ttfv.observe(now - slot.req.submit_time)
+            # Per-view commit hook: streams the frame to a trajectory
+            # client the moment it lands in the record (no-op for plain
+            # view requests).  Called before the step advances so the
+            # frame index is the view just synthesised.
+            slot.req._commit_frame(slot.step, view)
+            if slot.req.is_trajectory:
+                self._traj_frames.inc()
             slot.step += 1
         # One params version per launched batch; remember it for the
         # result-cache key of requests that finish this step.

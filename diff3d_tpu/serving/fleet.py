@@ -220,6 +220,10 @@ class Replica:
             "session_records_total": sum(
                 self.session_records().values()),
             "engine_restarts": self.engine._restarts,
+            # Per-trajectory progress (frames committed / path length)
+            # for every camera-path request in flight on this replica —
+            # the ``GET /fleet`` view of the streaming pipeline.
+            "trajectories": self.engine.trajectory_progress(),
         }
 
 

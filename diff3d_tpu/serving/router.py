@@ -61,7 +61,10 @@ from diff3d_tpu.serving.scheduler import (EngineDraining, EngineOverloaded,
                                           FleetOverloaded, QueueFullError,
                                           ReplicaDraining, SessionLost,
                                           UnsupportedSchedule, ViewRequest)
-from diff3d_tpu.serving.server import build_request, make_http_server
+from diff3d_tpu.serving.server import (build_request,
+                                       build_trajectory_request,
+                                       make_http_server, remember_request,
+                                       result_payload)
 
 log = logging.getLogger(__name__)
 
@@ -482,13 +485,19 @@ class FleetService:
         payload (``session_id`` keys the affinity contract)."""
         req = build_request(payload, self.cfg)
         self.router.submit(req)
-        with self._requests_lock:
-            self._requests[req.id] = req
-            while len(self._requests) > 4 * self.cfg.serving.max_queue:
-                oldest = next(iter(self._requests))
-                if not self._requests[oldest].done():
-                    break
-                del self._requests[oldest]
+        remember_request(self._requests, self._requests_lock, req,  # lockcheck: disable=LC302(reference passed; remember_request locks)
+                         4 * self.cfg.serving.max_queue)
+        return req
+
+    def submit_trajectory(self, payload: dict) -> ViewRequest:
+        """Build + route a camera-path rendering request.  A trajectory
+        carrying ``session_id`` is the canonical sticky workload: every
+        frame commits to the owning replica's device-resident record,
+        and the zero-migration contract keeps it there."""
+        req = build_trajectory_request(payload, self.cfg)
+        self.router.submit(req)
+        remember_request(self._requests, self._requests_lock, req,  # lockcheck: disable=LC302(reference passed; remember_request locks)
+                         4 * self.cfg.serving.max_queue)
         return req
 
     def get_request(self, request_id: str) -> Optional[ViewRequest]:
@@ -496,15 +505,7 @@ class FleetService:
             return self._requests.get(request_id)
 
     def result_payload(self, req: ViewRequest) -> dict:
-        out = req.result(timeout=0)
-        return {
-            "id": req.id,
-            "status": "done",
-            "cached": req.cached,
-            "n_views": req.n_views,
-            "shape": list(out.shape),
-            "views": out.tolist(),
-        }
+        return result_payload(req)
 
     def rollout(self, params, version: Optional[str] = None,
                 drain_timeout_s: float = 60.0) -> dict:

@@ -316,6 +316,119 @@ class ViewRequest:
                  f"|{self.steps}|{params_version}|{extra}".encode())
         return h.hexdigest()
 
+    # -- per-view commit hook (trajectory streaming) ---------------------
+
+    def _commit_frame(self, view_index: int, frame: np.ndarray) -> None:
+        """Engine hook, called once per synthesised view right after the
+        view step that produced it.  No-op for plain view requests —
+        :class:`TrajectoryRequest` overrides it to stream frames to the
+        client before the request resolves."""
+
+    @property
+    def is_trajectory(self) -> bool:
+        return False
+
+
+class TrajectoryRequest(ViewRequest):
+    """A camera-path rendering job: one request = render every pose of a
+    trajectory, streaming frames to the client *as they commit* to the
+    record instead of only resolving at the end.
+
+    Same device contract as :class:`ViewRequest` — views 1..n_views-1
+    synthesised autoregressively from the view-0 conditioning image,
+    identical RNG stream, same Bucket space (so trajectory chunks from
+    different objects co-batch with each other and with plain view
+    requests through the shared compiled scan).  What it adds is a
+    monotonic frame buffer with its own condition variable: the engine
+    calls :meth:`_commit_frame` after each view step, and HTTP handler
+    threads block in :meth:`wait_frames` to stream them out (incremental
+    poll with ``?from=K``, or chunked NDJSON).
+
+    ``frame k`` (0-based) is synthesised view ``k + 1`` — the
+    conditioning view is never echoed back.  Frames arrive strictly in
+    commit order; on a result-cache hit (or any resolve that skipped
+    the engine) the buffer is backfilled from the full result so the
+    streaming surface behaves identically.
+    """
+
+    def __init__(self, views: dict, **kwargs):
+        super().__init__(views, **kwargs)
+        self._frames_lock = threading.Lock()
+        self._frames_cv = threading.Condition(self._frames_lock)
+        # Committed frames, strictly in order; index k = view k+1.
+        self._frames: List[np.ndarray] = []  # guarded-by: self._frames_lock
+
+    @property
+    def is_trajectory(self) -> bool:
+        return True
+
+    @property
+    def n_frames(self) -> int:
+        """Frames this trajectory renders (poses past the conditioning
+        view)."""
+        return self.n_views - 1
+
+    def _commit_frame(self, view_index: int, frame: np.ndarray) -> None:
+        with self._frames_cv:
+            # The engine commits views in order; anything else would
+            # break the autoregressive record, so drop out-of-order
+            # duplicates (watchdog rejection racing a late commit).
+            if view_index != len(self._frames) + 1:
+                return
+            self._frames.append(frame)
+            self._frames_cv.notify_all()
+
+    def frames_done(self) -> int:
+        with self._frames_lock:
+            return len(self._frames)
+
+    def frames_since(self, start: int = 0) -> List[np.ndarray]:
+        """Committed frames ``start..`` (non-blocking snapshot)."""
+        with self._frames_lock:
+            return list(self._frames[max(0, int(start)):])
+
+    def wait_frames(self, start: int,
+                    timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Block until at least one frame past ``start`` is committed
+        (or the request resolves), then return frames ``start..``.
+        Returns ``[]`` only on timeout or when the request finished with
+        ``start`` >= the final frame count; a failed request raises its
+        error once every committed frame has been consumed — frames
+        that did commit are always deliverable."""
+        start = max(0, int(start))
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._frames_cv:
+            while len(self._frames) <= start and not self._event.is_set():
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                self._frames_cv.wait(remaining)
+            got = list(self._frames[start:])
+        if not got and self._event.is_set():
+            err = self.error
+            if err is not None:
+                raise err
+        return got
+
+    # Resolution overrides: backfill the frame buffer on resolve (the
+    # result-cache path never runs the engine, so nothing committed) and
+    # wake streaming waiters on both resolve and reject — a client
+    # blocked in wait_frames must observe terminal states promptly.
+
+    def _resolve(self, result: np.ndarray) -> None:
+        super()._resolve(result)
+        with self._frames_cv:
+            for k in range(len(self._frames), result.shape[0]):
+                self._frames.append(result[k])
+            self._frames_cv.notify_all()
+
+    def _reject(self, exc: BaseException) -> None:
+        super()._reject(exc)
+        with self._frames_cv:
+            self._frames_cv.notify_all()
+
 
 class Scheduler:
     """Bounded, bucketed FIFO with deadline sweeping.

@@ -14,21 +14,39 @@ Surface:
     ``block=false`` returns ``202 {"id"}`` for later polling.  A
     ``(sampler_kind, steps)`` pair the replica has no compiled bucket
     for is rejected ``503`` with the supported schedules.
-  * ``GET /result/<id>`` — poll a submitted job.
+  * ``POST /trajectory`` — render a camera path as one request.  Body:
+    either ``{"views": {...}}`` with explicit poses (view 0 is the
+    conditioning view) or ``{"cond": {"img", "R", "T", "K"}, "path":
+    {"kind": "orbit"|"spiral"|"keyframes", "frames": N, ...}}`` (the
+    ``diff3d_tpu/trajectory`` spec grammar), plus the /synthesize
+    options and ``"stream"?: bool``.  Three response modes:
+    ``stream=true`` streams chunked NDJSON — a header line, then one
+    line per frame *as it commits to the record*, then a terminal
+    status line; ``block=false`` returns ``202 {"id", "n_frames"}``
+    for incremental polling; ``block=true`` (default) waits and
+    returns all frames at once.
+  * ``GET /result/<id>`` — poll a submitted job.  For trajectory
+    requests ``?from=K`` returns frames ``K..`` committed so far plus
+    progress (``200`` even while running) — the incremental-poll
+    streaming surface.
   * ``GET /healthz`` — liveness + engine/queue state (incl. supported
     schedules).
   * ``GET /metrics`` — text exposition; ``/metrics?format=json`` for the
-    structured snapshot.
+    structured snapshot (per-trajectory progress under
+    ``engine.trajectories``).
   * ``GET /stats`` — the structured snapshot (alias of
     ``/metrics?format=json``): per-bucket program-cache entries carry
     their step count and sampler kind.
   * ``GET /fleet`` — fleet topology + per-replica health/depth/sessions
-    (404 on a single-replica service; served when the front door is the
-    router's :class:`~diff3d_tpu.serving.router.FleetService`).
+    and trajectory progress (404 on a single-replica service; served
+    when the front door is the router's
+    :class:`~diff3d_tpu.serving.router.FleetService`).
 
 Backpressure maps to status codes, never to silent queuing: a full queue
 is ``429``, a request deadline is ``504``, a cancelled request ``409``,
-malformed input ``400``.
+malformed input ``400``.  A trajectory request hits the same bounded
+queue as everything else — its typed rejection arrives before the
+stream starts, as a plain JSON error response.
 """
 
 from __future__ import annotations
@@ -36,10 +54,11 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -50,7 +69,8 @@ from diff3d_tpu.serving.engine import Engine
 from diff3d_tpu.serving.metrics import MetricsRegistry
 from diff3d_tpu.serving.scheduler import (QueueFullError, RequestCancelled,
                                           RequestTimeout, Scheduler,
-                                          ViewRequest)
+                                          TrajectoryRequest, ViewRequest)
+from diff3d_tpu.trajectory import path_from_spec, trajectory_views
 
 log = logging.getLogger(__name__)
 
@@ -76,14 +96,9 @@ def _retry_after(exc: BaseException) -> Optional[int]:
     return max(1, int(round(after))) if after else None
 
 
-def build_request(payload: dict, cfg: Config) -> ViewRequest:
-    """Validate a JSON-shaped payload against the served model and build
-    the :class:`ViewRequest`.  Shared by the single-replica
-    :class:`ServingService` and the fleet router's front door — both
-    enforce the same ceilings before any replica is chosen."""
-    if "views" not in payload:
-        raise ValueError("payload must carry a 'views' object with "
-                         "imgs/R/T/K")
+def _request_kwargs(payload: dict, cfg: Config) -> dict:
+    """The ViewRequest/TrajectoryRequest keyword options shared by both
+    builders, with the ``n_views`` ceiling pre-checked."""
     n_views = payload.get("n_views")
     if n_views is not None:
         n_views = int(n_views)
@@ -92,14 +107,18 @@ def build_request(payload: dict, cfg: Config) -> ViewRequest:
                 f"n_views={n_views} exceeds the service ceiling "
                 f"{cfg.serving.max_views}")
     steps = payload.get("steps")
-    req = ViewRequest(
-        {k: np.asarray(v) for k, v in payload["views"].items()},
+    return dict(
         seed=int(payload.get("seed", 0)),
         n_views=n_views,
         timeout_s=payload.get("timeout_s"),
         sampler_kind=payload.get("sampler_kind"),
         steps=None if steps is None else int(steps),
         session_id=payload.get("session_id"))
+
+
+def _check_against_model(req: ViewRequest, cfg: Config) -> ViewRequest:
+    """Post-construction ceilings every front door enforces before any
+    replica is chosen."""
     if req.n_views > cfg.serving.max_views:
         raise ValueError(
             f"request spans {req.n_views} views, service ceiling is "
@@ -110,6 +129,109 @@ def build_request(payload: dict, cfg: Config) -> ViewRequest:
             f"image size {H}x{W} does not match the served model "
             f"({cfg.model.H}x{cfg.model.W})")
     return req
+
+
+def build_request(payload: dict, cfg: Config) -> ViewRequest:
+    """Validate a JSON-shaped payload against the served model and build
+    the :class:`ViewRequest`.  Shared by the single-replica
+    :class:`ServingService` and the fleet router's front door — both
+    enforce the same ceilings before any replica is chosen."""
+    if "views" not in payload:
+        raise ValueError("payload must carry a 'views' object with "
+                         "imgs/R/T/K")
+    req = ViewRequest(
+        {k: np.asarray(v) for k, v in payload["views"].items()},
+        **_request_kwargs(payload, cfg))
+    return _check_against_model(req, cfg)
+
+
+def build_trajectory_request(payload: dict,
+                             cfg: Config) -> TrajectoryRequest:
+    """Build a :class:`TrajectoryRequest` from a JSON-shaped payload.
+
+    Two input shapes: ``{"views": {...}}`` with explicit poses (view 0
+    conditions, views 1.. are the path), or ``{"cond": {"img", "R",
+    "T", "K"}, "path": <spec>}`` where the spec is compiled through
+    :func:`diff3d_tpu.trajectory.path_from_spec` — a path of N frames
+    becomes an (N+1)-view request, so the frame budget is
+    ``max_views - 1``.  Same ceilings as :func:`build_request`.
+    """
+    if "views" in payload:
+        views = {k: np.asarray(v) for k, v in payload["views"].items()}
+    else:
+        cond, path = payload.get("cond"), payload.get("path")
+        if cond is None or path is None:
+            raise ValueError(
+                "trajectory payload must carry either a 'views' object "
+                "or 'cond' ({img, R, T, K}) + 'path' (spec)")
+        missing = [k for k in ("img", "R", "T", "K") if k not in cond]
+        if missing:
+            raise ValueError(f"cond is missing {missing}")
+        path_R, path_T = path_from_spec(path)
+        views = trajectory_views(
+            np.asarray(cond["img"], np.float32),
+            np.asarray(cond["R"], np.float32),
+            np.asarray(cond["T"], np.float32),
+            np.asarray(cond["K"], np.float32), path_R, path_T)
+    req = TrajectoryRequest(views, **_request_kwargs(payload, cfg))
+    return _check_against_model(req, cfg)
+
+
+def remember_request(requests: "OrderedDict[str, ViewRequest]",
+                     lock: threading.Lock, req: ViewRequest,
+                     cap: int) -> None:
+    """Record an accepted request in a front door's id->request map,
+    evicting the oldest *finished* entries past ``cap`` (shared by the
+    single-replica service and the fleet front door)."""
+    with lock:
+        requests[req.id] = req
+        while len(requests) > cap:
+            oldest = next(iter(requests))
+            if not requests[oldest].done():
+                break
+            del requests[oldest]
+
+
+def result_payload(req: ViewRequest) -> dict:
+    """The terminal JSON body of a finished request (raises the
+    request's error if it failed).  Trajectory requests additionally
+    report their frame count — ``views`` and the streamed frames are
+    the same arrays in the same order."""
+    out = req.result(timeout=0)
+    body = {
+        "id": req.id,
+        "status": "done",
+        "cached": req.cached,
+        "n_views": req.n_views,
+        "shape": list(out.shape),
+        "views": out.tolist(),
+    }
+    if req.is_trajectory:
+        body["n_frames"] = req.n_frames
+        body["frames_committed"] = req.frames_done()
+    return body
+
+
+def trajectory_poll_payload(req: TrajectoryRequest, start: int) -> dict:
+    """Incremental-poll body for ``GET /result/<id>?from=K``: frames
+    ``K..`` committed so far, plus progress.  ``next`` is the ``from``
+    value that continues the stream without gaps or repeats."""
+    frames = req.frames_since(start)
+    done = req.done()
+    committed = req.frames_done()
+    body = {
+        "id": req.id,
+        "status": "done" if done and req.error is None else (
+            "failed" if done else "running"),
+        "n_frames": req.n_frames,
+        "frames_committed": committed,
+        "from": start,
+        "next": start + len(frames),
+        "frames": [f.tolist() for f in frames],
+    }
+    if done and req.error is not None:
+        body["error"] = str(req.error)
+    return body
 
 
 class ServingService:
@@ -191,14 +313,18 @@ class ServingService:
         """Build + schedule a request from a JSON-shaped payload."""
         req = build_request(payload, self.cfg)
         self.engine.submit(req)
-        with self._requests_lock:
-            self._requests[req.id] = req
-            # Bound the id->request map: drop oldest *finished* entries.
-            while len(self._requests) > 4 * self.cfg.serving.max_queue:
-                oldest = next(iter(self._requests))
-                if not self._requests[oldest].done():
-                    break
-                del self._requests[oldest]
+        remember_request(self._requests, self._requests_lock, req,
+                         4 * self.cfg.serving.max_queue)
+        return req
+
+    def submit_trajectory(self, payload: dict) -> TrajectoryRequest:
+        """Build + schedule a camera-path rendering request; frames
+        stream through the request's commit buffer as the engine
+        commits them (``POST /trajectory``)."""
+        req = build_trajectory_request(payload, self.cfg)
+        self.engine.submit(req)
+        remember_request(self._requests, self._requests_lock, req,
+                         4 * self.cfg.serving.max_queue)
         return req
 
     def get_request(self, request_id: str) -> Optional[ViewRequest]:
@@ -206,15 +332,7 @@ class ServingService:
             return self._requests.get(request_id)
 
     def result_payload(self, req: ViewRequest) -> dict:
-        out = req.result(timeout=0)
-        return {
-            "id": req.id,
-            "status": "done",
-            "cached": req.cached,
-            "n_views": req.n_views,
-            "shape": list(out.shape),
-            "views": out.tolist(),
-        }
+        return result_payload(req)
 
     def health(self) -> dict:
         alive = self.engine.alive
@@ -294,11 +412,27 @@ def make_http_server(service: ServingService, host: str,
                     self._send_json(200, snap())
             elif url.path.startswith("/result/"):
                 req = service.get_request(url.path[len("/result/"):])
+                qs = parse_qs(url.query or "")
                 if req is None:
                     self._send_json(404, {"error": "unknown request id"})
+                elif req.is_trajectory and "from" in qs:
+                    # Incremental poll: committed frames are deliverable
+                    # whether the request is still running, finished, or
+                    # even failed mid-path (the body carries the error).
+                    try:
+                        start = int(qs["from"][0])
+                    except ValueError:
+                        self._send_json(
+                            400, {"error": "from must be an integer"})
+                        return
+                    self._send_json(
+                        200, trajectory_poll_payload(req, start))
                 elif not req.done():
-                    self._send_json(202, {"id": req.id,
-                                          "status": "pending"})
+                    body = {"id": req.id, "status": "pending"}
+                    if req.is_trajectory:
+                        body["n_frames"] = req.n_frames
+                        body["frames_committed"] = req.frames_done()
+                    self._send_json(202, body)
                 elif req.error is not None:
                     self._send_json(_error_status(req.error),
                                     {"id": req.id,
@@ -309,27 +443,98 @@ def make_http_server(service: ServingService, host: str,
             else:
                 self._send_json(404, {"error": f"no route {url.path}"})
 
+        # -- chunked NDJSON streaming (POST /trajectory stream=true) ----
+
+        def _write_chunk(self, data: bytes) -> None:
+            self.wfile.write(b"%x\r\n" % len(data))
+            self.wfile.write(data)
+            self.wfile.write(b"\r\n")
+
+        def _stream_line(self, obj: dict) -> None:
+            self._write_chunk(json.dumps(obj).encode() + b"\n")
+
+        def _stream_trajectory(self, req: TrajectoryRequest,
+                               wait: float) -> None:
+            """Stream frames as they commit: HTTP/1.1 chunked transfer,
+            one JSON line per event.  The handler thread blocks in
+            ``wait_frames`` (never the engine); errors after the header
+            has gone out are delivered as a terminal NDJSON line since
+            the status line is already on the wire."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            self._stream_line({"id": req.id, "status": "streaming",
+                               "n_frames": req.n_frames,
+                               "n_views": req.n_views})
+            deadline = time.monotonic() + wait
+            sent = 0
+            while True:
+                try:
+                    frames = req.wait_frames(
+                        sent, timeout=max(
+                            0.05, min(1.0, deadline - time.monotonic())))
+                except BaseException as e:
+                    self._stream_line({"id": req.id, "status": "error",
+                                       "frames_committed": sent,
+                                       "http_status": _error_status(e),
+                                       "error": str(e)})
+                    break
+                for f in frames:
+                    self._stream_line({"frame": sent,
+                                       "view": f.tolist()})
+                    sent += 1
+                if req.done() and sent >= req.frames_done():
+                    err = req.error
+                    if err is None:
+                        self._stream_line({"id": req.id, "status": "done",
+                                           "frames_committed": sent,
+                                           "cached": req.cached})
+                    else:
+                        self._stream_line(
+                            {"id": req.id, "status": "error",
+                             "frames_committed": sent,
+                             "http_status": _error_status(err),
+                             "error": str(err)})
+                    break
+                if time.monotonic() > deadline:
+                    req.cancel()
+                    self._stream_line({"id": req.id, "status": "timeout",
+                                       "frames_committed": sent})
+                    break
+            self._write_chunk(b"")   # terminal zero-length chunk
+
         def do_POST(self):
             url = urlparse(self.path)
-            if url.path != "/synthesize":
+            if url.path not in ("/synthesize", "/trajectory"):
                 self._send_json(404, {"error": f"no route {url.path}"})
                 return
+            trajectory = url.path == "/trajectory"
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length) or b"{}")
-                req = service.submit(payload)
+                if trajectory:
+                    req = service.submit_trajectory(payload)
+                else:
+                    req = service.submit(payload)
             except Exception as e:
                 self._send_json(_error_status(e), {"error": str(e)},
                                 retry_after=_retry_after(e))
                 return
+            wait = float(payload.get(
+                "timeout_s", service.cfg.serving.default_timeout_s)) + 5.0
+            if trajectory and payload.get("stream", False):
+                self._stream_trajectory(req, wait)
+                return
             if not payload.get("block", True):
-                self._send_json(202, {"id": req.id, "status": "pending"})
+                body = {"id": req.id, "status": "pending"}
+                if trajectory:
+                    body["n_frames"] = req.n_frames
+                self._send_json(202, body)
                 return
             # Block the handler thread (not the engine) for the result.
-            wait = payload.get("timeout_s",
-                               service.cfg.serving.default_timeout_s)
             try:
-                req.result(timeout=float(wait) + 5.0)
+                req.result(timeout=wait)
                 self._send_json(200, service.result_payload(req))
             except Exception as e:
                 self._send_json(_error_status(e),

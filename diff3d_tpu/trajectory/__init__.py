@@ -1,0 +1,15 @@
+"""Camera trajectories: path generators for orbit-video serving.
+
+The serving side (``TrajectoryRequest`` in ``serving/scheduler.py``,
+``POST /trajectory`` in ``serving/server.py``) consumes these; the
+evaluation side scores the resulting frame sequences with
+``evaluation/consistency.py``.
+"""
+
+from diff3d_tpu.trajectory.paths import (PATH_KINDS, keyframe_path,
+                                         look_at, orbit_path,
+                                         path_from_spec, spiral_path,
+                                         trajectory_views)
+
+__all__ = ["PATH_KINDS", "look_at", "orbit_path", "spiral_path",
+           "keyframe_path", "path_from_spec", "trajectory_views"]

@@ -1,3 +1,4 @@
+from diff3d_tpu.utils.frames import save_frame_sequence
 from diff3d_tpu.utils.profiling import StepTimer, profile_window
 
-__all__ = ["StepTimer", "profile_window"]
+__all__ = ["StepTimer", "profile_window", "save_frame_sequence"]

@@ -20,9 +20,22 @@ take the least-loaded path, and each rate point additionally reports
 per-replica view counts and the utilization skew (hottest replica /
 even-split share; 1.0 = perfectly balanced).
 
+With ``--trajectory_lens L1,L2,...`` the bench switches to the
+trajectory sweep: each point submits ``--requests`` concurrent
+orbit-path trajectories of that length (one object session each — the
+interleaved multi-object load the shared compiled scan co-batches) and
+a streaming client drains each request's commit buffer, reporting
+frames/s, time-to-first-frame vs. path length, end-to-end latency and
+(with a fleet) the per-replica utilization skew plus a
+``sessions_migrated`` count asserting the zero-migration contract
+(must be 0).
+
 Usage (CPU smoke):
     JAX_PLATFORMS=cpu python tools/bench_serving.py --config test \
         --rates 2,8,32 --requests 12 --out runs/bench_serving.json
+    JAX_PLATFORMS=cpu python tools/bench_serving.py --config test \
+        --trajectory_lens 3,5 --requests 4 --replicas 3 \
+        --out runs/bench_trajectory.json
 
 On a real chip, use the model config the service will run
 (``--config srn64``) and rates around the measured per-view service time.
@@ -113,27 +126,23 @@ def _aggregate_snaps(snaps):
     return counters, hists
 
 
-def _run_rate(sampler, cfg, rate: float, args) -> dict:
-    import numpy as np
-
+def _build_fleet_or_single(sampler, cfg, args):
+    """Fresh service per sweep point (clean metrics windows).  Returns
+    ``(service, replicas_or_None, engines)``."""
     from diff3d_tpu.serving import FleetService, ServingService
 
-    fleet = args.replicas > 1
-    if fleet:
+    if args.replicas > 1:
         service = FleetService.build(sampler, cfg, n=args.replicas)
         service.start(serve_http=False)
-        replicas = service.replicas
-        engines = [rep.engine for rep in replicas]
-        submit = service.router.submit
-    else:
-        service = ServingService(sampler, cfg).start(serve_http=False)
-        replicas = None
-        engines = [service.engine]
-        submit = service.engine.submit
-    views = [_synthetic_views(args.n_views, cfg.model.H, i)
-             for i in range(args.requests)]
-    # Warm the fullest lane count so rate 0's first request doesn't pay
-    # the compile (every rate would otherwise time one compile each).
+        return service, service.replicas, [rep.engine
+                                           for rep in service.replicas]
+    service = ServingService(sampler, cfg).start(serve_http=False)
+    return service, None, [service.engine]
+
+
+def _warmup(engines, sampler, cfg, n_views: int, n_requests: int) -> None:
+    # Warm the fullest lane count so the first request doesn't pay the
+    # compile (every sweep point would otherwise time one compile each).
     # Lane counts go through the engine's rounding (power of two, then up
     # to the mesh's lane multiple) so the warmed shapes are exactly the
     # ones traffic will launch.  Fleet replicas share the sampler's jit
@@ -141,13 +150,24 @@ def _run_rate(sampler, cfg, rate: float, args) -> dict:
     from diff3d_tpu.sampling import record_capacity
     from diff3d_tpu.serving import Bucket
     from diff3d_tpu.serving.engine import lane_count
-    bucket = Bucket(cfg.model.H, cfg.model.W, record_capacity(args.n_views),
+    bucket = Bucket(cfg.model.H, cfg.model.W, record_capacity(n_views),
                     sampler.steps, sampler.sampler_kind)
     for eng in engines:
         for lanes in {lane_count(1, eng.max_batch, eng.lane_multiple),
-                      lane_count(min(eng.max_batch, args.requests or 1),
+                      lane_count(min(eng.max_batch, n_requests or 1),
                                  eng.max_batch, eng.lane_multiple)}:
             eng.programs.warmup(bucket, lanes, sampler.w.shape[0])
+
+
+def _run_rate(sampler, cfg, rate: float, args) -> dict:
+    import numpy as np
+
+    service, replicas, engines = _build_fleet_or_single(sampler, cfg, args)
+    fleet = replicas is not None
+    submit = service.router.submit if fleet else service.engine.submit
+    views = [_synthetic_views(args.n_views, cfg.model.H, i)
+             for i in range(args.requests)]
+    _warmup(engines, sampler, cfg, args.n_views, args.requests)
 
     from diff3d_tpu.serving.scheduler import ViewRequest
     reqs, latencies, errors = [], [], []
@@ -240,6 +260,145 @@ def _run_rate(sampler, cfg, rate: float, args) -> dict:
     return point
 
 
+def _trajectory_payload(n_frames: int, size: int, seed: int) -> dict:
+    """An orbit trajectory over a synthetic object: random conditioning
+    image, conditioning camera on the same orbit shell (one azimuth
+    back), path compiled server-side from the JSON spec — exactly the
+    ``POST /trajectory`` wire shape."""
+    import numpy as np
+
+    from diff3d_tpu.trajectory import orbit_path
+
+    r = np.random.RandomState(seed)
+    radius, elevation = 2.6, 20.0
+    step = 360.0 / max(1, n_frames)
+    cond_R, cond_T = orbit_path(1, radius=radius, elevation_deg=elevation,
+                                azimuth0_deg=-step)
+    return {
+        "cond": {
+            "img": r.randn(size, size, 3).astype(np.float32),
+            "R": cond_R[0], "T": cond_T[0],
+            "K": np.array([[size * 1.2, 0, size / 2],
+                           [0, size * 1.2, size / 2],
+                           [0, 0, 1]], np.float32),
+        },
+        "path": {"kind": "orbit", "frames": n_frames, "radius": radius,
+                 "elevation_deg": elevation},
+        "seed": seed,
+        "session_id": f"bench-obj-{seed}",
+    }
+
+
+def _run_trajectory(sampler, cfg, n_frames: int, args) -> dict:
+    """One trajectory sweep point: ``args.requests`` concurrent orbit
+    trajectories of ``n_frames`` frames, one object session each, every
+    request drained by a streaming client as frames commit."""
+    import numpy as np
+
+    service, replicas, engines = _build_fleet_or_single(sampler, cfg, args)
+    fleet = replicas is not None
+    payloads = [_trajectory_payload(n_frames, cfg.model.H, i)
+                for i in range(args.requests)]
+    _warmup(engines, sampler, cfg, n_frames + 1, args.requests)
+
+    lock = threading.Lock()
+    ttffs, latencies, errors = [], [], []
+
+    def drain(req, t_submit):
+        # Streaming client: consume the commit buffer as the engine
+        # fills it, like the chunked-HTTP reader would.
+        try:
+            sent, first = 0, None
+            while True:
+                chunk = req.wait_frames(sent,
+                                        timeout=args.timeout_s + 30)
+                if chunk and first is None:
+                    first = time.perf_counter() - t_submit
+                sent += len(chunk)
+                if not chunk:
+                    break
+            req.result(timeout=args.timeout_s + 30)
+            with lock:
+                ttffs.append(first)
+                latencies.append(req.done_time - req.submit_time)
+        except Exception as e:
+            with lock:
+                errors.append(str(e))
+
+    t0 = time.perf_counter()
+    drainers = []
+    for payload in payloads:
+        t_submit = time.perf_counter()
+        try:
+            req = service.submit_trajectory(payload)
+        except Exception as e:
+            errors.append(str(e))
+            continue
+        th = threading.Thread(target=drain, args=(req, t_submit),
+                              daemon=True)
+        th.start()
+        drainers.append(th)
+    for th in drainers:
+        th.join()
+    wall = time.perf_counter() - t0
+
+    if fleet:
+        snaps = [rep.metrics.snapshot() for rep in replicas]
+        counters, hists = _aggregate_snaps(snaps)
+        per_replica_views = {
+            rep.name: snap["counters"].get(
+                "serving_views_completed_total", 0)
+            for rep, snap in zip(replicas, snaps)}
+        ledgers = [rep.session_records() for rep in replicas]
+    else:
+        snap = service.metrics_snapshot()
+        counters, hists = snap["counters"], snap["histograms"]
+        per_replica_views, ledgers = None, None
+    service.stop()
+
+    frames_done = counters.get("serving_trajectory_frames_total", 0)
+    lat = np.asarray(sorted(latencies)) if latencies else np.zeros(0)
+    tf = np.asarray(sorted(t for t in ttffs if t is not None))
+    occ = hists.get("serving_batch_occupancy", {})
+    point = {
+        "trajectory_frames": n_frames,
+        "requests": args.requests,
+        "completed": len(latencies),
+        "errors": len(errors),
+        "error_sample": errors[:3],
+        "wall_s": round(wall, 3),
+        "frames_committed": frames_done,
+        "frames_per_sec": (round(frames_done / wall, 3)
+                           if wall else None),
+        "ttff_p50_s": (round(float(np.percentile(tf, 50)), 3)
+                       if tf.size else None),
+        "ttff_max_s": (round(float(tf[-1]), 3) if tf.size else None),
+        "latency_p50_s": (round(float(np.percentile(lat, 50)), 3)
+                          if lat.size else None),
+        "latency_p99_s": (round(float(np.percentile(lat, 99)), 3)
+                          if lat.size else None),
+        "occupancy_mean": round(occ.get("mean", 0.0), 3),
+    }
+    if fleet:
+        vals = list(per_replica_views.values())
+        mean = sum(vals) / len(vals) if vals else 0.0
+        owners = {}
+        for ledger in ledgers:
+            for sid in ledger:
+                owners[sid] = owners.get(sid, 0) + 1
+        point.update({
+            "replicas": args.replicas,
+            "per_replica_views": per_replica_views,
+            "utilization_skew": (round(max(vals) / mean, 3)
+                                 if mean else None),
+            # Sessions whose records appear on >1 replica's ledger —
+            # any non-zero value is a broken zero-migration contract.
+            "sessions_migrated": sum(
+                1 for n in owners.values() if n > 1),
+        })
+    return point
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", choices=["srn64", "srn128", "test"],
@@ -273,24 +432,49 @@ def main(argv=None) -> int:
                         "this many in-process replicas (sessionless "
                         "least-loaded placement); reports "
                         "per_replica_views + utilization_skew per rate")
+    p.add_argument("--trajectory_lens", default="",
+                   help="comma-separated orbit lengths (frames per "
+                        "path); when set the bench runs the trajectory "
+                        "sweep instead of the offered-load sweep: "
+                        "--requests concurrent single-object "
+                        "trajectories per point, streaming clients, "
+                        "frames/s + time-to-first-frame vs. length")
     p.add_argument("--out", default="runs/bench_serving.json")
     args = p.parse_args(argv)
 
+    traj_lens = [int(v) for v in args.trajectory_lens.split(",")
+                 if v.strip()]
+    if traj_lens:
+        # The service's n_views ceiling must clear the longest path
+        # (+1 for the conditioning view).
+        args.n_views = max(args.n_views, max(traj_lens) + 1)
     sampler, cfg = _build_service(args)
-    rates = [float(r) for r in args.rates.split(",") if r.strip()]
     points = []
-    for rate in rates:
-        print(f"bench_serving: rate={rate} rps ...", file=sys.stderr)
-        pt = _run_rate(sampler, cfg, rate, args)
-        print(f"bench_serving:   -> {pt['views_per_sec']} views/s, "
-              f"p50={pt['latency_p50_s']}s p99={pt['latency_p99_s']}s "
-              f"occupancy={pt['occupancy_mean']}", file=sys.stderr)
-        points.append(pt)
+    if traj_lens:
+        for n_frames in traj_lens:
+            print(f"bench_serving: trajectory {n_frames} frames x "
+                  f"{args.requests} objects ...", file=sys.stderr)
+            pt = _run_trajectory(sampler, cfg, n_frames, args)
+            print(f"bench_serving:   -> {pt['frames_per_sec']} frames/s, "
+                  f"ttff_p50={pt['ttff_p50_s']}s "
+                  f"p50={pt['latency_p50_s']}s "
+                  f"occupancy={pt['occupancy_mean']}", file=sys.stderr)
+            points.append(pt)
+    else:
+        rates = [float(r) for r in args.rates.split(",") if r.strip()]
+        for rate in rates:
+            print(f"bench_serving: rate={rate} rps ...", file=sys.stderr)
+            pt = _run_rate(sampler, cfg, rate, args)
+            print(f"bench_serving:   -> {pt['views_per_sec']} views/s, "
+                  f"p50={pt['latency_p50_s']}s p99={pt['latency_p99_s']}s "
+                  f"occupancy={pt['occupancy_mean']}", file=sys.stderr)
+            points.append(pt)
 
     import jax
 
     record = {
-        "bench": "serving_offered_load",
+        "bench": ("serving_trajectory_sweep" if traj_lens
+                  else "serving_offered_load"),
         "config": args.config,
         "platform": jax.devices()[0].platform,
         "num_devices": len(jax.devices()),
