@@ -42,6 +42,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
+import signal
 import sys
 import time
 
@@ -65,6 +67,40 @@ from diff3d_tpu.runtime.retry import BackendDialTimeout  # noqa: E402
 #: from the retry policy.  ``main`` embeds this in the structured
 #: failure JSON so a voided round shows exactly what the retry loop did.
 _LAST_DIAL = {"attempts": 0, "retries": []}
+
+#: Last phase the bench entered, and the partial payload accumulated so
+#: far.  Rounds r04/r05 died with NOTHING on stdout; now any death —
+#: harness SIGTERM, unexpected exception — emits a structured partial
+#: record carrying the phase reached, the dial retry trace, and every
+#: sub-metric already measured, so a failed round is diagnosable.
+_PHASE = {"reached": "start"}
+_PARTIAL: dict = {}
+
+_PHASE_SEQUENCE = (
+    "start", "dial", "train_srn64", "train_srn128", "sampler_srn64",
+    "sampler_srn64_sharded", "sampler_steps_sweep", "sampler_srn128",
+    "sampler_srn128_sharded", "sampler128_steps_sweep", "complete",
+)
+
+
+def _enter_phase(name: str) -> None:
+    _PHASE["reached"] = name
+
+
+def _partial_record(reason: str) -> dict:
+    """A parseable record of an incomplete round: what phase it reached,
+    what the dial's retry loop did, and every metric already in hand."""
+    return {
+        "metric": "bench_partial",
+        "value": None,
+        "unit": None,
+        "vs_baseline": None,
+        "error": reason,
+        "phase_reached": _PHASE["reached"],
+        "dial": {"attempts": _LAST_DIAL["attempts"],
+                 "retries": list(_LAST_DIAL["retries"])},
+        "partial": dict(_PARTIAL),
+    }
 
 
 def _run(global_batch: int, n_steps: int, accum: int = 1,
@@ -416,6 +452,42 @@ def _acquire_backend(attempts: int = 6, wait_s: float = 75.0):
 
 
 def main() -> int:
+    """Run the bench with an always-parseable exit: a SIGTERM from the
+    harness (``timeout`` sends TERM before KILL — round r05 died to
+    exactly this with no record) or an unexpected exception both emit a
+    structured partial-result record instead of nothing.  The previous
+    SIGTERM disposition is restored on return so an embedding process
+    (tests, a driving trainer) keeps its own handlers."""
+    _PHASE["reached"] = "start"
+    _PARTIAL.clear()
+
+    def _on_term(signum, frame):  # pragma: no cover - signal path
+        print(json.dumps(_partial_record(
+            "sigterm: killed before completion")), flush=True)
+        os._exit(0)
+
+    prev_term = None
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # pragma: no cover - non-main thread
+        prev_term = None
+    try:
+        return _bench_main()
+    except BaseException as e:
+        msg = str(e).splitlines()[0][:300] if str(e) else ""
+        print(json.dumps(_partial_record(
+            f"{type(e).__name__}: {msg}" if msg else type(e).__name__)),
+            flush=True)
+        return 0
+    finally:
+        if prev_term is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_term)
+            except ValueError:  # pragma: no cover
+                pass
+
+
+def _bench_main() -> int:
     import jax
 
     try:  # persistent compile cache across driver rounds
@@ -423,6 +495,7 @@ def main() -> int:
     except Exception:  # pragma: no cover
         pass
 
+    _enter_phase("dial")
     try:
         devices = _acquire_backend()
     except BackendDialTimeout as e:
@@ -436,6 +509,7 @@ def main() -> int:
             "vs_baseline": None,
             "error": "backend-dial-timeout",
             "detail": str(e).splitlines()[0][:300],
+            "phase_reached": _PHASE["reached"],
             "dial": {"attempts": _LAST_DIAL["attempts"],
                      "retries": list(_LAST_DIAL["retries"])},
         }))
@@ -450,6 +524,7 @@ def main() -> int:
             "vs_baseline": None,
             "error": f"backend init failed after retries: "
                      f"{str(e).splitlines()[0][:300]}",
+            "phase_reached": _PHASE["reached"],
             "dial": {"attempts": _LAST_DIAL["attempts"],
                      "retries": list(_LAST_DIAL["retries"])},
         }))
@@ -465,6 +540,7 @@ def main() -> int:
     configs = [(128, 2), (64, 1), (32, 1)] if on_accel else [(8, 1)]
     n_steps = 10 if on_accel else 3
 
+    _enter_phase("train_srn64")
     try:
         examples_per_sec, global_batch, accum, stats = _train_bench(
             configs, n_steps, "srn64")
@@ -475,10 +551,14 @@ def main() -> int:
             "unit": "examples/s",
             "vs_baseline": None,
             "error": str(e).splitlines()[0][:300],
+            "phase_reached": _PHASE["reached"],
+            "dial": {"attempts": _LAST_DIAL["attempts"],
+                     "retries": list(_LAST_DIAL["retries"])},
         }))
         return 0
     name = f"b{global_batch}" + (f"x{accum}accum" if accum > 1 else "")
-    payload = {
+    payload = _PARTIAL     # alias: a partial record carries it verbatim
+    payload.update({
         "metric": f"train_examples_per_sec_srn64_{name}_{platform}"
                   f"_x{ndev}",
         "value": round(examples_per_sec, 2),
@@ -486,12 +566,13 @@ def main() -> int:
         "vs_baseline": round(examples_per_sec / BASELINE_EXAMPLES_PER_SEC,
                              4),
         "windows": stats,
-    }
+    })
 
     # Secondary headline metrics ride in the same JSON line; CPU runs skip
     # them (a 128^2 CPU compile + 256-step sampler adds many minutes for
     # numbers nobody compares).
     if on_accel:
+        _enter_phase("train_srn128")
         try:
             eps128, gb128, ac128, stats128 = _train_bench([(16, 4), (8, 4)],
                                                           5, "srn128")
@@ -505,6 +586,7 @@ def main() -> int:
             }
         except Exception as e:
             payload["srn128"] = {"error": str(e).splitlines()[0][:200]}
+        _enter_phase("sampler_srn64")
         try:
             comms: dict = {}
             mem: dict = {}
@@ -528,6 +610,7 @@ def main() -> int:
             # Sharded runtime: one object per chip on the data axis.  The
             # unsharded block above keeps its longitudinal metric name;
             # per-chip scaling = value / sharded.sec_per_view.
+            _enter_phase("sampler_srn64_sharded")
             try:
                 sh_comms: dict = {}
                 sh_mem: dict = {}
@@ -549,12 +632,14 @@ def main() -> int:
             except Exception as e:
                 payload["sampler"]["sharded"] = {
                     "error": str(e).splitlines()[0][:200]}
+        _enter_phase("sampler_steps_sweep")
         try:
             # Few-step DDIM sweep at srn64: how wall-clock tracks the
             # 256 -> 8 model-call reduction on real hardware.
             payload["sampler_steps"] = _sampler_steps_sweep()
         except Exception as e:
             payload["sampler_steps"] = {"error": str(e).splitlines()[0][:200]}
+        _enter_phase("sampler_srn128")
         try:
             # Object-batch 2, 2 views each = 2 effective synthesised views
             # per batched 256-step scan at 16384 tokens/frame, full-width
@@ -580,6 +665,7 @@ def main() -> int:
             payload["sampler128"] = {"error": str(e).splitlines()[0][:200]}
         if ndev > 1 and isinstance(payload.get("sampler128"), dict) \
                 and "value" in payload["sampler128"]:
+            _enter_phase("sampler_srn128_sharded")
             try:
                 sh_spv, sh_raw, sh_eff = _sampler_bench(
                     "srn128", n_views=2, object_batch=ndev, use_mesh=True)
@@ -596,6 +682,7 @@ def main() -> int:
             except Exception as e:
                 payload["sampler128"]["sharded"] = {
                     "error": str(e).splitlines()[0][:200]}
+        _enter_phase("sampler128_steps_sweep")
         try:
             # Same sweep at the full-width 128^2 config (object-batched
             # like the sampler128 block so the scan stays amortised).
@@ -605,6 +692,8 @@ def main() -> int:
             payload["sampler128_steps"] = {
                 "error": str(e).splitlines()[0][:200]}
 
+    _enter_phase("complete")
+    payload["phase_reached"] = "complete"
     print(json.dumps(payload))
     return 0
 

@@ -6,8 +6,15 @@ broken ``DistributedSampler`` usage (``train.py:224-226``, see SURVEY.md
 §2.7).  TPU-native design:
 
   * each host draws its own disjoint slice of the global batch, derived
-    deterministically from ``(seed, step, host_id)`` — no sampler state to
-    synchronise and resume is exact: seek to any step by number;
+    deterministically from ``(seed, step, global_slot)`` — no sampler state
+    to synchronise and resume is exact: seek to any step by number;
+  * **elasticity determinism rule**: the global batch stream is a pure
+    function of ``(seed, step)`` alone — host ``h`` of ``H`` takes global
+    slots ``[h*B, (h+1)*B)`` of a per-step draw of ``H*B`` global slots.
+    Re-partitioning the same global batch across a *different* host count
+    (with the per-host batch size rescaled so ``H*B`` is constant) yields
+    the identical global stream, so an elastic re-mesh neither replays
+    nor skips examples;
   * a thread pool overlaps image decode with device compute;
   * :func:`prefetch_to_device` keeps ``depth`` batches in flight as sharded
     device arrays (the JAX equivalent of pinned-memory prefetch).
@@ -33,10 +40,12 @@ class InfiniteLoader:
     """Yields ``{'imgs':[B,V,H,W,3], 'R':[B,V,3,3], 'T':[B,V,3], 'K':[B,3,3]}``
     forever, ``B`` = per-host batch size.
 
-    Sampling is stateless-per-step: batch ``n`` on host ``h`` is a pure
-    function of ``(seed, n, h)``, so checkpoint resume replays the exact
-    data order without any loader state (the reference's resume restores
-    only the step counter, ``train.py:244-251``).
+    Sampling is stateless-per-step: the *global* batch ``n`` is a pure
+    function of ``(seed, n)`` and host ``h`` takes global slots
+    ``[h*B, (h+1)*B)`` of it, so checkpoint resume replays the exact data
+    order without any loader state (the reference's resume restores only
+    the step counter, ``train.py:244-251``) and an elastic host-count
+    change re-derives the same global stream under the new partition.
     """
 
     def __init__(self, dataset, batch_size: int, *, seed: int = 0,
@@ -48,12 +57,13 @@ class InfiniteLoader:
         * ``'iid'`` (default, training) — objects drawn independently with
           replacement per slot;
         * ``'permute'`` — without-replacement epoch permutations: global
-          draw ``g = (step*num_hosts + host) * batch_size + slot`` indexes
-          a per-epoch shuffle of the dataset, so every object is seen
+          draw ``g = step * global_batch + global_slot`` indexes a
+          per-epoch shuffle of the dataset, so every object is seen
           exactly once per ``len(dataset)`` consecutive global draws (the
           reference's epoch semantics, ``SRNdataset.py:12-40``) while
-          staying a pure function of ``(seed, step, host)``.  Default for
-          val loaders — no double-counted objects in small val splits.
+          staying a pure function of ``(seed, step, global_slot)``.
+          Default for val loaders — no double-counted objects in small
+          val splits.
         """
         if sample_mode not in ("iid", "permute"):
             raise ValueError(f"unknown sample_mode {sample_mode!r}")
@@ -74,8 +84,8 @@ class InfiniteLoader:
         perm = self._perm_cache.get(epoch)
         if perm is None:
             # Distinct ENTROPY (not just spawn_key) from the per-sample
-            # streams: _batch's root spawn((step, host)) children are
-            # (step, host, slot) keys over entropy=seed, so any key-only
+            # streams: _batch's root spawn((step,)) children are
+            # (step, global_slot) keys over entropy=seed, so any key-only
             # scheme could collide (spawn appends a child index).  The
             # permutation is shared by all hosts.
             rng = np.random.default_rng(np.random.SeedSequence(
@@ -87,13 +97,19 @@ class InfiniteLoader:
         return perm
 
     def _batch(self, step: int) -> Dict[str, np.ndarray]:
-        root = np.random.SeedSequence(
-            entropy=self.seed, spawn_key=(step, self.host_id))
-        seqs = root.spawn(self.batch_size)
+        # Elasticity determinism: spawn the *global* batch's seed streams
+        # (spawn_key depends on step only) and slice this host's
+        # contiguous slot range.  Any (host_id, num_hosts) partition of
+        # the same global batch size reproduces the identical global
+        # stream, so a re-mesh resumes without replaying or skipping.
+        global_batch = self.batch_size * self.num_hosts
+        lo = self.host_id * self.batch_size
+        root = np.random.SeedSequence(entropy=self.seed, spawn_key=(step,))
+        seqs = root.spawn(global_batch)[lo:lo + self.batch_size]
         n = len(self.dataset)
 
         if self.sample_mode == "permute":
-            g0 = (step * self.num_hosts + self.host_id) * self.batch_size
+            g0 = step * global_batch + lo
             idxs = [int(self._epoch_perm((g0 + b) // n)[(g0 + b) % n])
                     for b in range(self.batch_size)]
         else:

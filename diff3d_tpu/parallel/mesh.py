@@ -98,6 +98,19 @@ class MeshEnv:
         return {jax.tree_util.keystr(path): str(tuple(sh.spec))
                 for path, sh in flat}
 
+    def topology_summary(self) -> dict:
+        """JSON-able description of the mesh topology this env shards
+        over.  Stamped into checkpoint manifests so a restore into a
+        *different* topology is recognised as a first-class reshard (and
+        logged as such) rather than silently assumed identical — the
+        elasticity loop's re-mesh contract (docs/DESIGN.md §16)."""
+        return {
+            "axes": {k: int(v) for k, v in self.mesh.shape.items()},
+            "n_devices": int(self.mesh.size),
+            "n_processes": int(jax.process_count()),
+            "param_sharding": self.cfg.param_sharding,
+        }
+
     def params(self, pytree) -> object:
         """Sharding pytree for params/opt-state per the config policy."""
         mode = self.cfg.param_sharding

@@ -66,6 +66,52 @@ def maybe_initialize_distributed(coordinator_address: str | None = None,
     return True
 
 
+def shutdown_distributed() -> bool:
+    """Tear down the multi-host runtime if one is up; True if it was.
+
+    The elasticity path (``train/trainer.py::ElasticSupervisor``) calls
+    this between re-mesh cycles: after a host-set change the old
+    coordinator channel is stale, and ``jax.distributed.initialize``
+    refuses while a previous client exists.  Safe to call when nothing
+    was initialised (returns False) — single-process chaos tests drive
+    the same code path as a real pod shrink.
+    """
+    try:
+        from jax._src import distributed as _dist
+        live = getattr(_dist.global_state, "client", None) is not None
+    except Exception:  # pragma: no cover - private API moved
+        live = True  # let shutdown() itself decide
+    if not live:
+        return False
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # pragma: no cover - best effort teardown
+        log.warning("jax.distributed.shutdown failed: %s", e)
+        return False
+    log.info("jax.distributed torn down for re-mesh")
+    return True
+
+
+def reinitialize_distributed(coordinator_address: str | None = None,
+                             num_processes: int | None = None,
+                             process_id: int | None = None,
+                             retry: RetryPolicy | None = None) -> bool:
+    """Tear down and re-dial the multi-host runtime for a new host set.
+
+    One re-mesh cycle of the elasticity loop: :func:`shutdown_distributed`
+    drops the stale coordinator client, then
+    :func:`maybe_initialize_distributed` re-dials under the usual
+    bring-up retry policy (workers race the restarted coordinator exactly
+    as at first launch).  Returns the new multi-process status.
+    Single-process runs (no coordinator) are a cheap no-op returning
+    False, so the supervisor can call this unconditionally.
+    """
+    shutdown_distributed()
+    return maybe_initialize_distributed(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id, retry=retry)
+
+
 def is_primary() -> bool:
     """True on the process that owns checkpoint/metric writes (the
     reference gates these on rank 0, ``train.py:287-298``)."""

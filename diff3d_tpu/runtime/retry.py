@@ -161,6 +161,40 @@ class RetryPolicy:
         raise AssertionError("unreachable")  # pragma: no cover
 
 
+class RetryBudget:
+    """Progress-aware failure budget for long-lived supervision loops.
+
+    A plain ``RetryPolicy`` bounds *consecutive* attempts of one call; an
+    elasticity loop instead needs "give up only after N failures *without
+    forward progress*": a run that trains for an hour, gets preempted,
+    re-meshes and trains on has earned a fresh budget, while a mesh that
+    crashes at bring-up N times in a row is genuinely dead.
+
+    ``spend()`` consumes one unit and returns True while budget remains;
+    ``reset()`` refills it (call on observed progress, e.g. the step
+    counter advanced past where the cycle started).  Not thread-safe —
+    owned by a single supervisor loop.
+    """
+
+    def __init__(self, max_failures: int):
+        if max_failures < 1:
+            raise ValueError(f"max_failures must be >= 1, got {max_failures}")
+        self.max_failures = max_failures
+        self.spent = 0
+
+    def spend(self) -> bool:
+        """Consume one failure; True iff the budget is not yet exhausted."""
+        self.spent += 1
+        return self.spent < self.max_failures
+
+    def reset(self) -> None:
+        self.spent = 0
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.max_failures - self.spent)
+
+
 def acquire_backend(attempts: int = 6, wait_s: float = 75.0, *,
                     dial_timeout_s: int = 180,
                     attempts_log: Optional[List[dict]] = None,

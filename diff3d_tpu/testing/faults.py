@@ -205,6 +205,33 @@ class FaultInjector:
         return wrapped
 
 
+def wrap_iter(it, injector: FaultInjector, site: str):
+    """Instrument an iterator so every ``__next__`` fires ``site`` first.
+
+    The chaos harness's kill seam for input pipelines: wrapping a
+    trainer's loader makes each batch fetch a fault site, so a
+    ``kind="sigterm"`` spec at a given call number delivers preemption
+    at an exact step boundary (and across elasticity cycles the per-site
+    call counter keeps counting, so one schedule spans re-meshes).
+    ``close()`` passes through when the inner iterator has one.
+    """
+
+    class _FaultyIter:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            injector.fire(site)
+            return next(it)
+
+        def close(self):
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
+    return _FaultyIter()
+
+
 class _FaultySampler:
     """Proxy delegating everything to a real sampler, with ``step_many``
     instrumented.  Attribute reads (``w``, ``lane_multiple``, ...) pass

@@ -59,6 +59,31 @@ _MARKER = "ckpt_format.json"
 _SLICED_MANIFEST = "sliced_manifest.json"
 MODES = ("full", "ema_bf16", "full_sliced")
 
+
+class CheckpointMismatchError(ValueError):
+    """A checkpoint/target disagreement caught by restore preflight.
+
+    Raised *before* any ``device_put`` when the on-disk manifest and the
+    target abstract state disagree on tree structure, a leaf's shape or
+    a leaf's dtype — naming the offending leaf, expected vs found, and
+    the checkpoint step, instead of letting the mismatch surface as a
+    raw XLA error deep inside resharding.  Subclasses ``ValueError`` so
+    callers that caught the old untyped errors keep working.
+
+    Note: a *topology* (mesh) difference is NOT an error — resharding a
+    checkpoint into a different mesh is the elasticity loop's normal
+    resume path (see :attr:`CheckpointManager.last_restore_reshard`).
+    Only value-changing mismatches (shape/dtype/structure) are refused.
+    """
+
+    def __init__(self, msg: str, *, leaf: str | None = None,
+                 expected=None, found=None, step: int | None = None):
+        super().__init__(msg)
+        self.leaf = leaf
+        self.expected = expected
+        self.found = found
+        self.step = step
+
 #: Per-leaf device->host fetch retry for sliced saves.  Any exception is
 #: retried (matching the historical behavior: a transient link fault
 #: costs one leaf's retry, not the whole save); the delays mirror the
@@ -143,6 +168,15 @@ class CheckpointManager:
         else:
             self.mode = mode or "full"
         self._keep = keep
+        #: Optional ``MeshEnv.topology_summary()`` dict; when set, sliced
+        #: manifests record the mesh the state was sharded over at save
+        #: time, and restore logs a first-class reshard when the target
+        #: topology differs (writer-thread-free: set once at bring-up).
+        self.mesh_info: dict | None = None
+        #: After a restore whose save-time mesh differs from the current
+        #: one: ``{"step", "from", "to"}`` (None otherwise).  The
+        #: elasticity supervisor reads this to log/metric the reshard.
+        self.last_restore_reshard: dict | None = None
         self._fire = fault_hook or (lambda site: None)
         self._fetch_retry = fetch_retry or _DEFAULT_FETCH_RETRY
         self._write_retry = write_retry or _DEFAULT_WRITE_RETRY
@@ -223,9 +257,19 @@ class CheckpointManager:
         """
         self._fire("snapshot")
         step = int(jax.device_get(state.step))
-        leaves, _ = jax.tree_util.tree_flatten(state)
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        leaves = [leaf for _, leaf in flat]
         arrays: List[np.ndarray] = []
-        manifest = {"step": step, "leaves": []}
+        manifest = {
+            "step": step,
+            "leaves": [],
+            # Leaf paths make preflight mismatches nameable ("params.
+            # conv1.kernel expects ..."), and the save-time mesh makes a
+            # cross-topology restore a recognised reshard, not a guess.
+            "paths": [jax.tree_util.keystr(p) for p, _ in flat],
+        }
+        if self.mesh_info is not None:
+            manifest["mesh"] = self.mesh_info
         for i, leaf in enumerate(leaves):
             def _fetch(leaf=leaf):
                 # MUST be an owned copy: device_get may return a
@@ -344,29 +388,60 @@ class CheckpointManager:
         d = os.path.join(self._dir, str(step))
         with open(os.path.join(d, _SLICED_MANIFEST)) as f:
             manifest = json.load(f)
-        abs_leaves, treedef = jax.tree_util.tree_flatten(abstract_state)
+        abs_flat, treedef = jax.tree_util.tree_flatten_with_path(
+            abstract_state)
+        abs_leaves = [leaf for _, leaf in abs_flat]
+        abs_paths = [jax.tree_util.keystr(p) for p, _ in abs_flat]
+        # Older manifests (pre-elasticity) carry no paths: name leaves by
+        # the target's paths, which are positionally correct whenever the
+        # leaf count matches at all.
+        paths = manifest.get("paths") or abs_paths
         if len(abs_leaves) != len(manifest["leaves"]):
-            raise ValueError(
-                f"sliced checkpoint at {d} has {len(manifest['leaves'])} "
-                f"leaves; the target state has {len(abs_leaves)} — "
-                "model/optimizer config mismatch")
-        out = []
+            raise CheckpointMismatchError(
+                f"sliced checkpoint at {d} (step {step}) has "
+                f"{len(manifest['leaves'])} leaves; the target state has "
+                f"{len(abs_leaves)} — model/optimizer config mismatch",
+                expected=len(abs_leaves), found=len(manifest["leaves"]),
+                step=step)
+        # Preflight the WHOLE manifest before touching any device: a
+        # mismatch at leaf 400 must not surface after 399 device_puts.
         for i, (sds, meta) in enumerate(zip(abs_leaves,
                                             manifest["leaves"])):
+            name = paths[i] if i < len(paths) else f"leaf {i}"
             if tuple(meta["shape"]) != tuple(sds.shape):
-                raise ValueError(
-                    f"sliced checkpoint at {d}: leaf {i} has shape "
-                    f"{tuple(meta['shape'])}, target expects "
-                    f"{tuple(sds.shape)} — model/optimizer config "
-                    "mismatch")
+                raise CheckpointMismatchError(
+                    f"sliced checkpoint at {d} (step {step}): leaf "
+                    f"{name!r} has shape {tuple(meta['shape'])}, target "
+                    f"expects {tuple(sds.shape)} — model/optimizer "
+                    "config mismatch",
+                    leaf=name, expected=tuple(sds.shape),
+                    found=tuple(meta["shape"]), step=step)
             if meta["dtype"] != str(sds.dtype):
                 # A dtype mismatch is a config mismatch (e.g. restoring a
                 # float32 run into a bf16-param config): silently casting
                 # would hand back numerically different weights.
-                raise ValueError(
-                    f"sliced checkpoint at {d}: leaf {i} was saved as "
-                    f"{meta['dtype']}, target expects {sds.dtype} — "
-                    "model/optimizer config mismatch")
+                raise CheckpointMismatchError(
+                    f"sliced checkpoint at {d} (step {step}): leaf "
+                    f"{name!r} was saved as {meta['dtype']}, target "
+                    f"expects {sds.dtype} — model/optimizer config "
+                    "mismatch",
+                    leaf=name, expected=str(sds.dtype),
+                    found=meta["dtype"], step=step)
+        saved_mesh = manifest.get("mesh")
+        self.last_restore_reshard = None
+        if saved_mesh is not None and self.mesh_info is not None \
+                and saved_mesh != self.mesh_info:
+            # First-class reshard: the slices below are device_put into
+            # the TARGET topology's shardings — restoring an 8-device
+            # checkpoint onto 4 devices (or vice versa) is the elasticity
+            # loop's normal resume, not an error.
+            self.last_restore_reshard = {
+                "step": step, "from": saved_mesh, "to": self.mesh_info}
+            log.info("resharding checkpoint step %d: saved on %s -> "
+                     "restoring into %s", step, saved_mesh, self.mesh_info)
+        out = []
+        for i, (sds, meta) in enumerate(zip(abs_leaves,
+                                            manifest["leaves"])):
             arr = np.load(os.path.join(d, f"leaf_{i:05d}.npy"))
             if meta["dtype"] == "bfloat16":
                 arr = jnp.asarray(arr.view(np.uint16)).view(jnp.bfloat16)

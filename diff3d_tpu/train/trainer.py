@@ -13,13 +13,15 @@ steps-per-sec / examples-per-sec), optional ``jax.profiler`` traces.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import os
+import random
 import signal
 import threading
 import time
-from typing import Iterator, Optional
+from typing import Callable, Iterator, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +32,7 @@ from diff3d_tpu.diffusion import p_losses
 from diff3d_tpu.models import XUNet
 from diff3d_tpu.parallel import MeshEnv, make_mesh
 from diff3d_tpu.parallel.multihost import is_primary
-from diff3d_tpu.runtime.retry import (RetryPolicy,
+from diff3d_tpu.runtime.retry import (RetryBudget, RetryPolicy,
                                       is_transient_backend_error)
 from diff3d_tpu.train.checkpoint import CheckpointManager
 from diff3d_tpu.train.state import TrainState, create_train_state
@@ -97,6 +99,11 @@ class Trainer:
             keep=cfg.train.keep_checkpoints,
             mode=cfg.train.ckpt_mode,
             async_writes=cfg.train.ckpt_async)
+        # Stamp the mesh topology BEFORE any restore: sliced manifests
+        # record the save-time mesh, and a restore into a different
+        # topology is then recognised (and logged) as a first-class
+        # reshard — the elasticity re-mesh contract (DESIGN.md §16).
+        self.ckpt.mesh_info = self.env.topology_summary()
         if transfer:
             if self.ckpt.mode == "ema_bf16":
                 # Warm restart: EMA-only checkpoints carry no optimizer
@@ -136,6 +143,8 @@ class Trainer:
         self._metrics_path = os.path.join(workdir, "metrics.jsonl")
         self._preempted = threading.Event()
         self.preempt_observed_step: Optional[int] = None
+        self._preempt_uninstall = None   # cached by install_preemption_handler
+        self._in_handler = False         # re-entrancy guard (main thread only)
         self._eval_fn = None
         self.val_loader: Optional[Iterator] = None
 
@@ -154,29 +163,53 @@ class Trainer:
         handlers — installation is no longer forever, so tests and
         embedding processes (e.g. a notebook driving several trainers)
         can scope the handler to one training run.
+
+        Idempotent and re-entrant (the elasticity loop installs and
+        uninstalls every re-mesh cycle): a second ``install`` returns
+        the existing uninstaller instead of chaining the handler onto
+        itself, a second ``uninstall()`` is a no-op, and a signal
+        arriving while the handler is already running only sets the stop
+        flag — it does not recursively re-chain the previous handler.
         """
+        if self._preempt_uninstall is not None:
+            # Already installed: handing out a fresh chain here would
+            # make the handler its own `prev` and recurse on delivery.
+            return self._preempt_uninstall
 
         prev = {}
 
         def handler(signum, frame):
             log.warning("signal %d: checkpointing and stopping", signum)
             self._preempted.set()
-            # Chain whatever handler was installed before us — on pods,
-            # jax.distributed.initialize registers the preemption-sync
-            # notifier on SIGTERM, and clobbering it would leave
-            # reached_preemption_sync_point permanently False.  The
-            # default SIGINT handler is deliberately NOT chained: it
-            # raises KeyboardInterrupt, which would turn this graceful
-            # stop into the emergency-checkpoint crash path.
-            p = prev.get(signum)
-            if callable(p) and p is not signal.default_int_handler:
-                p(signum, frame)
+            if self._in_handler:
+                # Signal-during-signal (repeated SIGTERM from an
+                # impatient scheduler): the flag is set, the chained
+                # notifier already ran — re-chaining would recurse.
+                return
+            self._in_handler = True
+            try:
+                # Chain whatever handler was installed before us — on
+                # pods, jax.distributed.initialize registers the
+                # preemption-sync notifier on SIGTERM, and clobbering it
+                # would leave reached_preemption_sync_point permanently
+                # False.  The default SIGINT handler is deliberately NOT
+                # chained: it raises KeyboardInterrupt, which would turn
+                # this graceful stop into the emergency-checkpoint crash
+                # path.
+                p = prev.get(signum)
+                if callable(p) and p is not signal.default_int_handler:
+                    p(signum, frame)
+            finally:
+                self._in_handler = False
 
         for s in signals:
             prev[s] = signal.getsignal(s)
             signal.signal(s, handler)
 
         def uninstall():
+            if self._preempt_uninstall is not uninstall:
+                return                   # already uninstalled: no-op
+            self._preempt_uninstall = None
             for s, p in prev.items():
                 # Only restore what we still own — if someone installed
                 # their own handler after us, clobbering it here would
@@ -184,6 +217,7 @@ class Trainer:
                 if signal.getsignal(s) is handler:
                     signal.signal(s, p if p is not None else signal.SIG_DFL)
 
+        self._preempt_uninstall = uninstall
         return uninstall
 
     def _stop_requested(self, step: int) -> bool:
@@ -416,3 +450,219 @@ class Trainer:
 
         self.ckpt.wait()
         return self.state
+
+
+# ---- elasticity -----------------------------------------------------
+
+#: Typed elasticity states (DESIGN.md §16).  They flow into the train
+#: log and ``metrics.jsonl`` as ``{"elastic": <state>, ...}`` records so
+#: a long elastic run is auditable after the fact: every disruption, the
+#: topology it re-meshed to, and the step it resumed from.
+ELASTIC_RUNNING = "RUNNING"
+ELASTIC_REMESHING = "REMESHING"
+ELASTIC_RESUMED = "RESUMED"
+ELASTIC_GAVE_UP = "GAVE_UP"
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticEvent:
+    """One elasticity state transition."""
+
+    state: str          # one of the ELASTIC_* constants
+    cycle: int          # 1-based re-mesh cycle this event belongs to
+    step: int           # trainer step at the transition
+    n_devices: int      # device count of the cycle's mesh (0 = unknown)
+    reason: str = ""    # disruption cause / reshard description
+    wall_s: float = 0.0
+
+    def record(self) -> dict:
+        return {"elastic": self.state, "cycle": self.cycle,
+                "step": self.step, "n_devices": self.n_devices,
+                "reason": self.reason, "wall_s": round(self.wall_s, 3)}
+
+
+class ElasticityGaveUp(RuntimeError):
+    """The supervisor exhausted its no-progress failure budget.
+
+    Carries the full event history so the operator (or the chaos
+    harness) sees every cycle's disposition, not just the last error.
+    """
+
+    def __init__(self, msg: str, events: List[ElasticEvent]):
+        super().__init__(msg)
+        self.events = list(events)
+
+
+class ElasticSupervisor:
+    """Re-mesh-and-resume loop around :meth:`Trainer.train`.
+
+    The dynamic half of fault tolerance (ROADMAP item 5; PR 3 landed the
+    static half): on a preemption (SIGTERM observed by the trainer's
+    handler) or a transient backend fault (failed collective, reset
+    transport), the supervisor tears the live cycle down, re-initialises
+    the distributed runtime for the surviving host set, rebuilds the
+    mesh/shardings for the new topology, restores the latest durable
+    checkpoint — resharded into the new mesh by the ``full_sliced``
+    restore path — and resumes the input pipeline deterministically
+    (``make_loader(step, env)`` re-derives each host's shard of the
+    global stream from the restored step; see the loader's elasticity
+    determinism rule).
+
+    Give-up policy: ``retry.max_attempts`` consecutive cycles *without
+    forward progress* (the durable step never advanced) exhaust the
+    :class:`~diff3d_tpu.runtime.retry.RetryBudget` and raise
+    :class:`ElasticityGaveUp`; any cycle that advanced the step refills
+    the budget — a run preempted hourly for a week should never die.
+
+    Seams (all injectable, so chaos tests script real topology changes
+    on a single host):
+
+    * ``make_loader(step, env)`` — build the cycle's input iterator,
+      seeked to ``step`` and partitioned for ``env``'s topology;
+    * ``topology_fn()`` — devices for the next mesh (None = all);
+    * ``reinit_fn()`` — distributed-runtime re-dial (default re-dials
+      only on real multi-process jobs via
+      :func:`~diff3d_tpu.parallel.multihost.reinitialize_distributed`);
+    * ``fault_hook(site)`` — fired at ``"elastic.cycle"`` each bring-up
+      (a :class:`~diff3d_tpu.testing.faults.FaultInjector` seam).
+    """
+
+    def __init__(self, cfg: Config,
+                 make_loader: Callable[[int, MeshEnv], Iterator],
+                 workdir: str = ".",
+                 topology_fn: Optional[Callable[[], list]] = None,
+                 reinit_fn: Optional[Callable[[], object]] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 fault_hook: Optional[Callable[[str], None]] = None):
+        self.cfg = cfg
+        self.make_loader = make_loader
+        self.workdir = workdir
+        self.topology_fn = topology_fn
+        self.reinit_fn = reinit_fn
+        self.retry = retry or RetryPolicy(
+            max_attempts=8, base_delay_s=2.0, max_delay_s=60.0,
+            classify=is_transient_backend_error)
+        self._budget = RetryBudget(self.retry.max_attempts)
+        self._fire = fault_hook or (lambda site: None)
+        self._metrics_path = os.path.join(workdir, "metrics.jsonl")
+        self._lock = threading.Lock()
+        self._events: List[ElasticEvent] = []  # guarded-by: self._lock
+        self.trainer: Optional[Trainer] = None
+
+    @property
+    def events(self) -> List[ElasticEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def _emit(self, ev: ElasticEvent) -> None:
+        with self._lock:
+            self._events.append(ev)
+        # File IO strictly after the lock is released (LC303): the event
+        # list is shared with readers, the metrics file is not.
+        log.warning("elastic %s: cycle %d step %d on %d devices%s",
+                    ev.state, ev.cycle, ev.step, ev.n_devices,
+                    f" ({ev.reason})" if ev.reason else "")
+        if is_primary():
+            with open(self._metrics_path, "a") as f:
+                f.write(json.dumps(ev.record()) + "\n")
+
+    def _give_up(self, cycle: int, step: int, n_dev: int, reason: str,
+                 t0: float) -> None:
+        self._emit(ElasticEvent(ELASTIC_GAVE_UP, cycle, step, n_dev,
+                                reason, time.monotonic() - t0))
+        raise ElasticityGaveUp(
+            f"elasticity budget exhausted: {self._budget.spent} "
+            f"consecutive no-progress cycles (last: {reason})", self.events)
+
+    def run(self, max_steps: Optional[int] = None) -> TrainState:
+        """Train to ``max_steps``, surviving preemptions and transient
+        backend faults by re-meshing; returns the final state."""
+        max_steps = (max_steps if max_steps is not None
+                     else self.cfg.train.max_steps)
+        t0 = time.monotonic()
+        rng = random.Random(self.retry.seed)
+        cycle = 0
+        while True:
+            cycle += 1
+            trainer = None
+            loader = None
+            uninstall = None
+            step0 = -1
+            n_dev = 0
+            try:
+                self._fire("elastic.cycle")
+                if self.reinit_fn is not None:
+                    self.reinit_fn()
+                elif jax.process_count() > 1:  # pragma: no cover - pods
+                    from diff3d_tpu.parallel.multihost import \
+                        reinitialize_distributed
+                    reinitialize_distributed()
+                devices = (self.topology_fn()
+                           if self.topology_fn is not None else None)
+                env = make_mesh(self.cfg.mesh, devices=devices)
+                n_dev = int(env.mesh.size)
+                trainer = Trainer(self.cfg, env=env, workdir=self.workdir,
+                                  transfer=True)
+                self.trainer = trainer
+                step0 = int(trainer.state.step)
+                reshard = trainer.ckpt.last_restore_reshard
+                reason = ""
+                if reshard is not None:
+                    reason = (f"resharded step {reshard['step']}: "
+                              f"{reshard['from']['n_devices']} -> "
+                              f"{reshard['to']['n_devices']} devices")
+                loader = self.make_loader(step0, env)
+                trainer.loader = loader
+                self._emit(ElasticEvent(
+                    ELASTIC_RESUMED if cycle > 1 else ELASTIC_RUNNING,
+                    cycle, step0, n_dev, reason, time.monotonic() - t0))
+                uninstall = trainer.install_preemption_handler()
+                state = trainer.train(max_steps)
+                step = int(state.step)
+                if step >= max_steps:
+                    return state
+                # train() returned early: graceful preemption.  Progress
+                # refills the budget; a sigterm storm pinning us to the
+                # same step eventually exhausts it.
+                if step > step0:
+                    self._budget.reset()
+                elif not self._budget.spend():
+                    self._give_up(cycle, step, n_dev,
+                                  "preempted without progress", t0)
+                self._emit(ElasticEvent(
+                    ELASTIC_REMESHING, cycle, step, n_dev, "preemption",
+                    time.monotonic() - t0))
+            except (FloatingPointError, ElasticityGaveUp):
+                raise   # poisoned state / exhausted budget: not elastic
+            except Exception as exc:
+                if not is_transient_backend_error(exc):
+                    raise
+                fail_step = step0
+                if trainer is not None:
+                    try:
+                        fail_step = int(trainer.state.step)
+                    except Exception:  # pragma: no cover - dead backend
+                        pass
+                if trainer is not None and fail_step > step0 >= 0:
+                    self._budget.reset()
+                elif not self._budget.spend():
+                    self._give_up(cycle, max(fail_step, 0), n_dev,
+                                  f"{type(exc).__name__}: {exc}", t0)
+                self._emit(ElasticEvent(
+                    ELASTIC_REMESHING, cycle, max(fail_step, 0), n_dev,
+                    f"{type(exc).__name__}: {exc}", time.monotonic() - t0))
+                self.retry.sleep(self.retry.delay_for(
+                    max(1, self._budget.spent), rng))
+            finally:
+                if uninstall is not None:
+                    uninstall()
+                if loader is not None and hasattr(loader, "close"):
+                    try:
+                        loader.close()
+                    except Exception:  # pragma: no cover - best effort
+                        log.exception("loader close failed during re-mesh")
+                if trainer is not None:
+                    try:
+                        trainer.ckpt.close()
+                    except Exception:  # pragma: no cover - best effort
+                        log.exception("ckpt close failed during re-mesh")

@@ -113,6 +113,31 @@ def test_infinite_loader_host_sharding_disjoint_streams():
     assert not np.array_equal(h0["imgs"], h1["imgs"])
 
 
+def test_infinite_loader_global_stream_invariant_to_host_count():
+    """The elasticity determinism rule: for a fixed global batch size,
+    the concatenation of all hosts' batches at a step is identical for
+    any host count — so a re-mesh (grow or shrink) resumes the same
+    global stream without replaying or skipping examples."""
+    ds = SyntheticDataset(num_objects=3, num_views=5, imgsize=8)
+    G = 8
+    for mode in ("iid", "permute"):
+        ref = InfiniteLoader(ds, G, seed=3, num_workers=0,
+                             sample_mode=mode)
+        refs = [next(ref) for _ in range(3)]
+        for H in (2, 4):
+            loaders = [InfiniteLoader(ds, G // H, seed=3, host_id=h,
+                                      num_hosts=H, num_workers=0,
+                                      sample_mode=mode)
+                       for h in range(H)]
+            for step in range(3):
+                parts = [next(ld) for ld in loaders]
+                for k in ("imgs", "R", "T", "K"):
+                    np.testing.assert_array_equal(
+                        np.concatenate([p[k] for p in parts]),
+                        refs[step][k],
+                        err_msg=f"mode={mode} hosts={H} step={step} {k}")
+
+
 def test_infinite_loader_resume_replays_exact_stream():
     ds = SyntheticDataset(num_objects=3, num_views=5, imgsize=8)
     fresh = InfiniteLoader(ds, 2, seed=7, num_workers=0)

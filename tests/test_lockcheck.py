@@ -42,6 +42,7 @@ _THREADED_MODULES = (
     "diff3d_tpu/serving/router.py",
     "diff3d_tpu/serving/server.py",
     "diff3d_tpu/train/checkpoint.py",
+    "diff3d_tpu/train/trainer.py",
     "diff3d_tpu/data/loader.py",
     "diff3d_tpu/native/__init__.py",
 )

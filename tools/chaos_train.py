@@ -1,0 +1,172 @@
+"""Seeded kill/shrink/grow soak for the elastic training loop.
+
+Drives :class:`~diff3d_tpu.train.trainer.ElasticSupervisor` on a tiny
+synthetic config over virtual CPU devices, with a seeded
+:class:`~diff3d_tpu.testing.faults.FaultInjector` delivering real
+SIGTERMs at scripted batch fetches and a scripted topology schedule that
+alternates the mesh between the full device set and half of it — so
+every re-mesh cycle is also a shrink (8→4) or grow (4→8) reshard of the
+``full_sliced`` checkpoint.
+
+Contract checked (DESIGN.md §16):
+
+  * the run reaches the target step despite every kill (no GAVE_UP),
+  * **zero lost steps** — every ``REMESHING`` at step ``S`` is followed
+    by a ``RESUMED`` at exactly ``S``: nothing replayed, nothing
+    skipped,
+  * every scheduled kill was delivered and produced a typed
+    ``REMESHING``/``RESUMED`` pair in the event log,
+  * device counts actually changed across cycles (the reshard path ran).
+
+Exit status is 0 iff all of the above hold.
+
+Usage (CPU):
+    python tools/chaos_train.py --devices 8 --steps 8 --kills 3 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _ensure_devices(n: int) -> None:
+    """Force ``n`` virtual CPU devices — must run before jax imports."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def run_soak(devices: int, steps: int, kills: int, seed: int,
+             workdir: str) -> dict:
+    import dataclasses
+
+    import jax
+
+    from diff3d_tpu.config import test_config
+    from diff3d_tpu.data import InfiniteLoader, SyntheticDataset
+    from diff3d_tpu.testing.faults import FaultInjector, wrap_iter
+    from diff3d_tpu.train.trainer import (ELASTIC_REMESHING,
+                                          ELASTIC_RESUMED,
+                                          ElasticityGaveUp,
+                                          ElasticSupervisor)
+
+    n_all = len(jax.devices())
+    half = max(1, n_all // 2)
+    cfg = test_config(imgsize=8, ch=8, shallow=True)
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(
+            cfg.train, max_steps=steps, ckpt_every=2, log_every=0,
+            global_batch=8, ckpt_mode="full_sliced", ckpt_async=True))
+
+    rng = random.Random(seed)
+    inj = FaultInjector(seed=seed)
+    # Kill schedule over the loader's absolute call counter (it spans
+    # re-mesh cycles).  Each kill costs one extra fetch on resume (the
+    # preempted step's batch is re-derived), so consecutive kills sit
+    # >= 2 calls apart to guarantee forward progress between them.
+    at, c = [], 1
+    for _ in range(kills):
+        c += rng.randint(2, max(2, steps // max(1, kills)))
+        at.append(c)
+    inj.add("loader", kind="sigterm", at_calls=tuple(at))
+
+    ds = SyntheticDataset(num_objects=4, num_views=4, imgsize=cfg.model.H)
+    cycle_devs: list = []
+
+    def topology_fn():
+        # Alternate full/half device sets: every re-mesh is a real
+        # shrink or grow, so every resume exercises the reshard path.
+        n = n_all if len(cycle_devs) % 2 == 0 else half
+        cycle_devs.append(n)
+        return jax.devices()[:n]
+
+    def make_loader(step, env):
+        inner = InfiniteLoader(ds, cfg.train.global_batch,
+                               seed=cfg.train.seed, num_workers=0,
+                               start_step=step)
+        return wrap_iter(inner, inj, "loader")
+
+    supervisor = ElasticSupervisor(cfg, make_loader, workdir=workdir,
+                                   topology_fn=topology_fn,
+                                   reinit_fn=lambda: None)
+    gave_up = None
+    final_step = -1
+    try:
+        state = supervisor.run(steps)
+        final_step = int(state.step)
+    except ElasticityGaveUp as e:
+        gave_up = str(e)
+
+    events = supervisor.events
+    remesh = [e for e in events if e.state == ELASTIC_REMESHING]
+    resumed = [e for e in events if e.state == ELASTIC_RESUMED]
+    # Zero-lost-steps accounting: REMESHING at S must resume at S.
+    lost = sum(abs(r.step - m.step) for m, r in zip(remesh, resumed))
+    dev_counts = [e.n_devices for e in events]
+    result = {
+        "survived": (gave_up is None and final_step >= steps
+                     and lost == 0
+                     and int(inj.fired["loader"]) >= kills
+                     and len(set(dev_counts)) > 1),
+        "target_steps": steps,
+        "final_step": final_step,
+        "cycles": len(resumed) + 1,
+        "kills_scheduled": kills,
+        "kills_delivered": int(inj.fired["loader"]),
+        "lost_steps": lost,
+        "device_counts": dev_counts,
+        "gave_up": gave_up,
+        "events": [e.record() for e in events],
+    }
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual CPU device count (shrink runs at half)")
+    p.add_argument("--steps", type=int, default=8,
+                   help="target optimizer step the soak must reach")
+    p.add_argument("--kills", type=int, default=3,
+                   help="SIGTERMs delivered across the run")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--workdir", default=None,
+                   help="run directory (default: fresh tempdir, removed "
+                        "on success)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full result record as one JSON line")
+    args = p.parse_args(argv)
+
+    _ensure_devices(args.devices)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_train_")
+    try:
+        result = run_soak(args.devices, args.steps, args.kills,
+                          args.seed, workdir)
+    finally:
+        if args.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(f"chaos_train: step {result['final_step']}/"
+              f"{result['target_steps']}, {result['kills_delivered']} "
+              f"kills, {result['cycles']} cycles over device sets "
+              f"{result['device_counts']}, lost_steps="
+              f"{result['lost_steps']}, survived={result['survived']}")
+    return 0 if result["survived"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
