@@ -189,9 +189,18 @@ def _run(global_batch: int, n_steps: int, accum: int = 1,
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
             (state, batch))
-        report = ir_lib.analyze_lowered(
-            f"train_step_{config}",
-            step_fn.lower(abstract[0], abstract[1], rng))
+        # rngcheck stream digest from the SAME trace: determinism
+        # provenance travels with the perf number (docs/DESIGN.md §17).
+        from diff3d_tpu.analysis.rngflow import install_rng_witness
+
+        witness, uninstall = install_rng_witness()
+        try:
+            lowered = step_fn.lower(abstract[0], abstract[1], rng)
+        finally:
+            uninstall()
+        stats["rng_stream"] = {"digest": witness.digest(),
+                               "n_events": len(witness.events)}
+        report = ir_lib.analyze_lowered(f"train_step_{config}", lowered)
         stats["comms"] = ir_lib.comms_summary(report)
         # memcheck memory block from the SAME lower+compile pass: peak
         # HBM, donation effectiveness, hoistable scan-invariant FLOPs
@@ -250,7 +259,8 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
                    sampler_kind: str = "ancestral",
                    steps: int | None = None,
                    comms_out: dict | None = None,
-                   mem_out: dict | None = None):
+                   mem_out: dict | None = None,
+                   rng_out: dict | None = None):
     """Seconds per synthesised view, reference sampler config (256 steps,
     8-weight guidance sweep, ``/root/reference/sampling.py:130-158``) —
     one compiled lax.scan per view.  ``srn128`` runs the full-resolution
@@ -280,7 +290,10 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     an ``error`` note instead.  ``mem_out`` is the same contract for the
     memcheck memory summary (peak HBM / donation table / hoistable
     scan-invariant FLOPs — ``analysis/mem.py``), extracted from the
-    same lower+compile pass.
+    same lower+compile pass.  ``rng_out`` is the same contract for the
+    rngcheck stream digest (ordered key-derivation events witnessed
+    during the lower — ``analysis/rngflow.py``), so bench rounds carry
+    determinism provenance next to comms and memory.
     """
     import jax
     import numpy as np
@@ -305,15 +318,23 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
                       scan_chunks=chunks, mesh=mesh_env,
                       sampler_kind=sampler_kind, steps=steps)
 
-    if comms_out is not None or mem_out is not None:
+    if comms_out is not None or mem_out is not None or rng_out is not None:
         try:
             from diff3d_tpu.analysis import ir as ir_lib
             from diff3d_tpu.analysis import mem as mem_lib
+            from diff3d_tpu.analysis.rngflow import install_rng_witness
             from diff3d_tpu.sampling.runtime import record_capacity
 
             lanes = max(object_batch, sampler.lane_multiple)
-            lowered = sampler.lower_step_many(
-                lanes, record_capacity(n_views))
+            witness, uninstall = install_rng_witness()
+            try:
+                lowered = sampler.lower_step_many(
+                    lanes, record_capacity(n_views))
+            finally:
+                uninstall()
+            if rng_out is not None:
+                rng_out.update({"digest": witness.digest(),
+                                "n_events": len(witness.events)})
             report = ir_lib.analyze_lowered(
                 f"step_many_{config}", lowered)
             if comms_out is not None:
@@ -321,7 +342,7 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
             if mem_out is not None and report.memory is not None:
                 mem_out.update(mem_lib.memory_summary(report.memory))
         except Exception as e:
-            for d in (comms_out, mem_out):
+            for d in (comms_out, mem_out, rng_out):
                 if d is not None:
                     d["error"] = str(e).splitlines()[0][:200]
 
@@ -590,8 +611,9 @@ def _bench_main() -> int:
         try:
             comms: dict = {}
             mem: dict = {}
+            rng_stream: dict = {}
             sec_per_view, raw_s, n_eff = _sampler_bench(
-                comms_out=comms, mem_out=mem)
+                comms_out=comms, mem_out=mem, rng_out=rng_stream)
             payload["sampler"] = {
                 "metric": f"sampler_sec_per_view_srn64_{platform}",
                 "value": round(sec_per_view, 2),
@@ -602,6 +624,7 @@ def _bench_main() -> int:
                 "chips_used": 1,
                 "comms": comms,
                 "mem": mem,
+                "rng_stream": rng_stream,
             }
         except Exception as e:
             payload["sampler"] = {"error": str(e).splitlines()[0][:200]}
@@ -614,9 +637,11 @@ def _bench_main() -> int:
             try:
                 sh_comms: dict = {}
                 sh_mem: dict = {}
+                sh_rng: dict = {}
                 sh_spv, sh_raw, sh_eff = _sampler_bench(
                     object_batch=ndev, use_mesh=True,
-                    comms_out=sh_comms, mem_out=sh_mem)
+                    comms_out=sh_comms, mem_out=sh_mem,
+                    rng_out=sh_rng)
                 payload["sampler"]["sharded"] = {
                     "chips_used": ndev,
                     "sec_per_view": round(sh_spv, 2),
@@ -628,6 +653,7 @@ def _bench_main() -> int:
                     if sh_spv else None,
                     "comms": sh_comms,
                     "mem": sh_mem,
+                    "rng_stream": sh_rng,
                 }
             except Exception as e:
                 payload["sampler"]["sharded"] = {

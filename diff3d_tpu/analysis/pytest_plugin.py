@@ -41,6 +41,13 @@ package — pytest resolves the module off ``sys.path``).  Exposes:
     is used) bounds the number of requested-but-unaliased donations.
     Same vacuous-pass protection: a marked test that never registers a
     report fails.
+  * ``@pytest.mark.rng_lineage`` — the test runs with the RNG stream
+    witness installed (every key-consuming ``jax.random`` entry point
+    is wrapped; see ``analysis/rngflow.py``); at teardown the test
+    fails on any key consumed more than once while the witness was
+    live.  The test must request the ``rng_witness`` fixture, and a
+    marked test under which no ``jax.random`` event was ever recorded
+    fails — the check would pass vacuously.
 """
 
 from __future__ import annotations
@@ -171,6 +178,11 @@ def pytest_configure(config):
         "programs analyzed via the mem_check fixture may not exceed "
         "these memory/recompute limits (aggregated; enforced at "
         "teardown; ineffective donations forbidden unless budgeted)")
+    config.addinivalue_line(
+        "markers",
+        "rng_lineage: run the test with the RNG stream witness "
+        "installed (via the rng_witness fixture); fails at teardown "
+        "on any jax.random key consumed more than once")
 
 
 @pytest.hookimpl(tryfirst=True)
@@ -238,6 +250,18 @@ def pytest_runtest_setup(item):
             pytest.fail(
                 f"{item.nodeid}: @pytest.mark.lock_witness requires the "
                 "lock_witness fixture — request it so the witness is "
+                "installed around the test body", pytrace=False)
+
+    marker = item.get_closest_marker("rng_lineage")
+    if marker is not None:
+        if marker.args or marker.kwargs:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.rng_lineage takes no "
+                "arguments", pytrace=False)
+        if "rng_witness" not in item.fixturenames:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.rng_lineage requires the "
+                "rng_witness fixture — request it so the witness is "
                 "installed around the test body", pytrace=False)
 
 
@@ -321,5 +345,31 @@ def lock_witness(request):
     if violations:
         pytest.fail(
             f"{request.node.nodeid}: lock witness found "
+            f"{len(violations)} violation(s):\n"
+            + "\n".join(violations), pytrace=False)
+
+
+@pytest.fixture
+def rng_witness(request):
+    from diff3d_tpu.analysis.rngflow import install_rng_witness
+
+    witness, uninstall = install_rng_witness()
+    try:
+        yield witness
+    finally:
+        uninstall()
+    marker = request.node.get_closest_marker("rng_lineage")
+    if marker is None:
+        return
+    if not witness.events:
+        pytest.fail(
+            f"{request.node.nodeid}: @pytest.mark.rng_lineage but no "
+            "jax.random event was ever witnessed — the check would "
+            "pass vacuously; the code under test must derive/consume "
+            "keys while the witness is installed", pytrace=False)
+    violations = witness.violations()
+    if violations:
+        pytest.fail(
+            f"{request.node.nodeid}: rng witness found "
             f"{len(violations)} violation(s):\n"
             + "\n".join(violations), pytrace=False)

@@ -1,0 +1,857 @@
+"""rngflow: the linear-key dataflow core behind GL101 and rngcheck.
+
+JAX PRNG keys are **linear resources**: each key is either *derived
+from* (``split`` / ``fold_in``) or *consumed by* (a draw) exactly once
+— reusing a key replays its stream, and every determinism contract in
+this repo (the ancestral-256 bit-parity oracle, the chunked carried-RNG
+schedule independence, the elastic consumed-batch-stream invariant)
+sits on top of that discipline.  This module is the shared machinery:
+
+  * the **single-scope linear scanner** — per function, source-ordered
+    consume/store events over plain-name keys, exactly graftlint
+    GL101's model (a re-store re-arms the carry: ``rng, k = split(rng)``
+    stays silent).  GL101 is now a thin alias over
+    :func:`linear_violations` with no call graph, so the fast path and
+    rngcheck's RC501/RC502 can never disagree on the shared cases;
+  * the **program graph** — every ``def`` in the analyzed file set with
+    an interprocedural *consumes* summary computed to fixpoint: a
+    function consumes a key parameter if its body (or anything it
+    passes the key to, across modules) draws from it before rebinding
+    it.  Call resolution is conservative: exact for same-module defs
+    and ``from diff3d_tpu...`` imports, bare-name with
+    all-candidates-must-agree otherwise, silent for anything ambiguous;
+  * the **lineage annotation grammar** — ``# rng-lineage:`` trailing
+    comments on a ``def`` header declaring key params and overriding
+    the inferred summary (``keys(...)``, ``not-keys(...)``,
+    ``consumes(...)``, ``passthrough(...)``) plus free-text
+    ``stream(...)`` docs for derivation schemes the dataflow cannot
+    see (numpy ``SeedSequence`` trees, teacher/student splits);
+  * the **runtime witness** (:func:`install_rng_witness`) — wraps the
+    key-consuming ``jax.random`` entry points so a trace (``.lower``)
+    or an eager run records an ordered stream of key-derivation events
+    and per-key consumption counts; a key consumed twice is a recorded
+    violation.  The ordered event list digests into the per-program
+    stream manifests committed under ``runs/rngcheck/``;
+  * the **loader stream probe** — drives the real
+    :class:`~diff3d_tpu.data.loader.InfiniteLoader` seed-derivation
+    path (numpy ``SeedSequence`` spawn tree + epoch permutations) on a
+    stub dataset and digests the drawn streams, so the elastic
+    "global batch is a pure function of (seed, step)" invariant is
+    pinned by manifest too.
+
+No ``jax`` import at module level: graftlint (pure AST, used in
+editors) imports this file; everything runtime lives behind lazy
+imports.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from diff3d_tpu.analysis.rules.context import (ModuleContext, dotted_name,
+                                               param_names)
+
+#: jax.random attrs that do NOT consume their key argument.  ``split``
+#: is deliberately absent: the *parent* of a split is spent (reusing it
+#: replays the children) — that is RC502's whole subject.
+NON_CONSUMING = {"PRNGKey", "key", "fold_in", "key_data",
+                 "wrap_key_data", "key_impl", "clone",
+                 "default_prng_impl"}
+
+#: jax.random attrs that derive new keys from a parent (assignment
+#: targets of these calls are tracked as derived keys for RC503).
+DERIVING = {"split", "fold_in", "PRNGKey", "key", "clone"}
+
+#: Dotted-name roots whose calls never consume a key linearly (library
+#: namespaces the repo treats as value-semantics).  ``jax.random`` draws
+#: are recognised *before* this set applies.
+SAFE_CALL_ROOTS = {
+    "jax", "jnp", "np", "numpy", "lax", "math", "os", "sys", "json",
+    "time", "optax", "flax", "nn", "chex", "functools", "itertools",
+    "logging", "threading", "queue", "ast", "re", "dataclasses",
+    "collections", "einops",
+}
+
+#: Builtin callables that never consume a key.
+SAFE_BUILTINS = {
+    "print", "len", "int", "float", "str", "bool", "list", "tuple",
+    "dict", "set", "frozenset", "sorted", "min", "max", "abs", "sum",
+    "isinstance", "issubclass", "repr", "zip", "enumerate", "range",
+    "map", "filter", "getattr", "setattr", "hasattr", "id", "type",
+    "iter", "next", "vars", "format", "hash",
+}
+
+#: Parameter names classified as PRNG keys by convention.
+KEY_NAME_RE = re.compile(
+    r"^(rngs?|keys?|k\d*|k_\w+|\w*_rngs?|\w*_keys?)$")
+
+
+def is_key_name(name: str) -> bool:
+    return bool(KEY_NAME_RE.match(name))
+
+
+# ---------------------------------------------------------------------
+# lineage annotations
+# ---------------------------------------------------------------------
+
+ANNOT_RE = re.compile(r"#\s*rng-lineage:\s*(.*)$")
+_DIRECTIVE_HEAD_RE = re.compile(r"\s*([A-Za-z][\w-]*)\s*\(")
+
+#: directive -> takes a name list (True) or free text (False).
+_DIRECTIVES = {"keys": True, "not-keys": True, "consumes": True,
+               "passthrough": True, "stream": False}
+
+
+@dataclasses.dataclass
+class LineageAnnotations:
+    """Parsed ``# rng-lineage:`` directives for one function."""
+
+    keys: Set[str] = dataclasses.field(default_factory=set)
+    not_keys: Set[str] = dataclasses.field(default_factory=set)
+    consumes: Set[str] = dataclasses.field(default_factory=set)
+    passthrough: Set[str] = dataclasses.field(default_factory=set)
+    streams: List[str] = dataclasses.field(default_factory=list)
+    #: (lineno, message) pairs for malformed directives (RC003).
+    errors: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list)
+
+    def __bool__(self) -> bool:
+        return bool(self.keys or self.not_keys or self.consumes
+                    or self.passthrough or self.streams or self.errors)
+
+
+def _parse_directives(spec: str, lineno: int,
+                      out: LineageAnnotations) -> None:
+    pos = 0
+    while pos < len(spec):
+        m = _DIRECTIVE_HEAD_RE.match(spec, pos)
+        if not m:
+            rest = spec[pos:].strip()
+            if rest:
+                out.errors.append(
+                    (lineno, f"unparseable rng-lineage text {rest!r} — "
+                             f"expected directive(...) tokens"))
+            return
+        directive = m.group(1)
+        # Balanced-paren argument (free text may nest parens).
+        depth, start = 0, m.end()
+        arg, end = None, None
+        for i in range(m.end() - 1, len(spec)):
+            if spec[i] == "(":
+                depth += 1
+            elif spec[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    arg, end = spec[start:i], i + 1
+                    break
+        if arg is None:
+            arg, end = spec[start:], len(spec)
+        pos = end
+        if directive not in _DIRECTIVES:
+            out.errors.append(
+                (lineno, f"unknown rng-lineage directive "
+                         f"'{directive}' — one of "
+                         f"{sorted(_DIRECTIVES)}"))
+            continue
+        if _DIRECTIVES[directive]:
+            names = {n.strip() for n in arg.split(",") if n.strip()}
+            bad = {n for n in names if not n.isidentifier()}
+            if bad or not names:
+                out.errors.append(
+                    (lineno, f"rng-lineage {directive}(...) needs a "
+                             f"comma-separated identifier list, got "
+                             f"{arg.strip()!r}"))
+                continue
+            attr = directive.replace("-", "_")
+            getattr(out, attr).update(names)
+        else:
+            text = arg.strip()
+            if not text:
+                out.errors.append(
+                    (lineno, "rng-lineage stream(...) is empty — "
+                             "describe the derivation scheme"))
+                continue
+            out.streams.append(text)
+
+
+def parse_lineage_annotations(ctx: ModuleContext,
+                              fn: ast.AST) -> LineageAnnotations:
+    """Directives on the ``def`` header lines (trailing comments on
+    the signature, which may span several lines) and in the contiguous
+    comment block immediately above the def/decorators."""
+    out = LineageAnnotations()
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return out
+    first = min([fn.lineno] + [d.lineno for d in fn.decorator_list])
+    above = first - 1
+    while above >= 1 and ctx.lines[above - 1].strip().startswith("#"):
+        above -= 1
+    first_body = fn.body[0].lineno if fn.body else fn.lineno + 1
+    for lineno in range(above + 1, first_body):
+        if lineno - 1 >= len(ctx.lines):
+            break
+        m = ANNOT_RE.search(ctx.lines[lineno - 1])
+        if m:
+            _parse_directives(m.group(1), lineno, out)
+    return out
+
+
+# ---------------------------------------------------------------------
+# single-scope linear scanner (shared GL101 / RC501 / RC502 core)
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Violation:
+    """One linearity violation: ``name`` consumed at ``node`` although
+    already consumed at ``prev_line`` (by a ``prev_kind`` event)."""
+
+    node: ast.AST
+    name: str
+    prev_line: int
+    prev_kind: str   # "draw" | "split" | "call"
+    kind: str        # the second consumption's kind
+    detail: str = ""  # callee name for "call" events
+
+
+def consuming_random_call(ctx: ModuleContext,
+                          node: ast.Call) -> Tuple[str, str]:
+    """``(key_name, kind)`` for a consuming ``jax.random`` call with a
+    plain-name first argument, else ``("", "")``.  ``kind`` is
+    ``"split"`` for split, ``"draw"`` otherwise."""
+    if not isinstance(node.func, ast.Attribute):
+        return "", ""
+    base = dotted_name(node.func.value)
+    if base not in ctx.random_aliases:
+        return "", ""
+    if node.func.attr in NON_CONSUMING:
+        return "", ""
+    if not node.args:
+        return "", ""
+    first = node.args[0]
+    if not isinstance(first, ast.Name):
+        return "", ""
+    kind = "split" if node.func.attr == "split" else "draw"
+    return first.id, kind
+
+
+def _scope_key(ctx: ModuleContext, node: ast.AST) -> int:
+    fn = ctx.enclosing_function(node)
+    return id(fn) if fn is not None else 0
+
+
+def collect_scope_events(
+        ctx: ModuleContext,
+        graph: Optional["ProgramGraph"] = None,
+) -> Dict[int, List[Tuple[Tuple[int, int], str, str, ast.AST, str]]]:
+    """Source-ordered key events grouped by enclosing function scope
+    (0 = module scope).  Events: ``(pos, kind, name, node, detail)``
+    with kind in {store, draw, split, call}.  ``graph`` enables the
+    interprocedural ``call`` consume events (a plain-name argument
+    handed to a resolved callee whose summary consumes that
+    parameter)."""
+    scopes: Dict[int, List[Tuple[Tuple[int, int], str, str,
+                                 ast.AST, str]]] = {}
+
+    def add(node, pos, kind, name, detail=""):
+        scopes.setdefault(_scope_key(ctx, node), []).append(
+            (pos, kind, name, node, detail))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name, kind = consuming_random_call(ctx, node)
+            if name:
+                add(node, (node.lineno, node.col_offset + 1), kind, name)
+                continue
+            if graph is not None:
+                for arg_name, callee in graph.consuming_call_args(
+                        ctx, node):
+                    add(node, (node.lineno, node.col_offset + 1),
+                        "call", arg_name, callee)
+        elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store):
+            # Stores get a line-end bias so `rng, k = split(rng)`
+            # re-arms rng (consume sorts before the same-line store).
+            add(node, (node.lineno, 10_000), "store", node.id)
+    for events in scopes.values():
+        events.sort(key=lambda e: e[0])
+    return scopes
+
+
+def linear_violations(
+        ctx: ModuleContext,
+        graph: Optional["ProgramGraph"] = None,
+        scopes: Optional[dict] = None) -> Iterator[Violation]:
+    """The linear-resource scan: a second consumption of a name with no
+    re-store in between is a violation.  Same continue-counting as the
+    original GL101 (each extra consumption reports once)."""
+    if scopes is None:
+        scopes = collect_scope_events(ctx, graph)
+    for events in scopes.values():
+        consumed_at: Dict[str, Tuple[int, str]] = {}
+        for _, kind, name, node, detail in events:
+            if kind == "store":
+                consumed_at.pop(name, None)
+            elif name in consumed_at:
+                prev_line, prev_kind = consumed_at[name]
+                yield Violation(node=node, name=name,
+                                prev_line=prev_line,
+                                prev_kind=prev_kind, kind=kind,
+                                detail=detail)
+                consumed_at[name] = (node.lineno, kind)
+            else:
+                consumed_at[name] = (node.lineno, kind)
+
+
+def dead_derived_keys(
+        ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    """Derived-but-never-used keys: a name assigned from a deriving
+    ``jax.random`` call (split / fold_in / PRNGKey / key) that is never
+    loaded anywhere else in its function (nested closures count as
+    use).  ``_``-prefixed names are sanctioned discards."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and dotted_name(value.func.value) in ctx.random_aliases
+                and value.func.attr in DERIVING):
+            continue
+        targets: List[ast.Name] = []
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                targets.append(t)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(e for e in t.elts
+                               if isinstance(e, ast.Name))
+        if not targets:
+            continue
+        scope = ctx.enclosing_function(node) or ctx.tree
+        in_value = {id(n) for n in ast.walk(value)}
+        for target in targets:
+            name = target.id
+            if name.startswith("_"):
+                continue
+            used = False
+            for other in ast.walk(scope):
+                if (isinstance(other, ast.Name) and other.id == name
+                        and isinstance(other.ctx, ast.Load)
+                        and id(other) not in in_value):
+                    used = True
+                    break
+            if not used:
+                yield target, name
+
+
+# ---------------------------------------------------------------------
+# program graph (interprocedural consumes-summary fixpoint)
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    """One ``def`` in the analyzed file set."""
+
+    path: str
+    module: str            # dotted module name ("" outside the package)
+    name: str
+    qualname: str
+    lineno: int
+    params: Tuple[str, ...]          # positional, self/cls dropped
+    kwonly: Tuple[str, ...]
+    has_varargs: bool
+    annotations: LineageAnnotations
+    #: params the function consumes (directly or via callees), to
+    #: fixpoint.  Annotations override: consumes() adds,
+    #: passthrough() removes.
+    consumes: Set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def all_params(self) -> Set[str]:
+        return set(self.params) | set(self.kwonly)
+
+    @property
+    def key_params(self) -> Set[str]:
+        names = {p for p in self.all_params if is_key_name(p)}
+        names |= self.annotations.keys
+        names -= self.annotations.not_keys
+        return names
+
+
+def _module_name(path: str) -> str:
+    norm = path.replace("\\", "/")
+    idx = norm.rfind("diff3d_tpu/")
+    if idx < 0:
+        return ""
+    mod = norm[idx:]
+    if mod.endswith(".py"):
+        mod = mod[:-3]
+    if mod.endswith("/__init__"):
+        mod = mod[:-len("/__init__")]
+    return mod.replace("/", ".")
+
+
+class ProgramGraph:
+    """Cross-module function index + consumes summaries.
+
+    Built once per rngcheck run over every analyzed file; rules then
+    re-scan their own :class:`ModuleContext` against it.  Summaries key
+    by ``(relpath-ish, name, lineno)`` so rules working on a *separate
+    parse* of the same file still resolve locally-defined callees."""
+
+    MAX_CANDIDATES = 4
+    _FIXPOINT_ROUNDS = 10
+
+    def __init__(self, sources: Dict[str, str]):
+        self.ctxs: List[ModuleContext] = []
+        self.by_name: Dict[str, List[FunctionSummary]] = {}
+        self.by_loc: Dict[Tuple[str, str, int], FunctionSummary] = {}
+        self.by_module: Dict[Tuple[str, str], FunctionSummary] = {}
+        #: per-ctx import alias tables, identity-checked (rule passes
+        #: hand us fresh ModuleContexts for the same files).
+        self._imports: Dict[int, Tuple[ModuleContext,
+                                       Dict[str, Tuple[str, str]]]] = {}
+        for path in sorted(sources):
+            try:
+                tree = ast.parse(sources[path], filename=path)
+            except SyntaxError:
+                continue
+            ctx = ModuleContext(path, sources[path], tree)
+            self.ctxs.append(ctx)
+            self._index_module(ctx)
+        self._fixpoint()
+
+    # -- construction ---------------------------------------------------
+
+    def _import_table(self, ctx: ModuleContext):
+        entry = self._imports.get(id(ctx))
+        if entry is not None and entry[0] is ctx:
+            return entry[1]
+        imports: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    imports[a.asname or a.name] = (node.module, a.name)
+        self._imports[id(ctx)] = (ctx, imports)
+        return imports
+
+    def _index_module(self, ctx: ModuleContext) -> None:
+        module = _module_name(ctx.path)
+
+        def qual(fn: ast.AST) -> str:
+            parts = [fn.name]
+            cur = ctx.parent.get(id(fn))
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.ClassDef)):
+                    parts.append(cur.name)
+                cur = ctx.parent.get(id(cur))
+            return ".".join(reversed(parts))
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            args = node.args
+            names = [a.arg for a in args.posonlyargs + args.args]
+            if names and names[0] in ("self", "cls"):
+                names = names[1:]
+            summary = FunctionSummary(
+                path=ctx.path, module=module, name=node.name,
+                qualname=qual(node), lineno=node.lineno,
+                params=tuple(names),
+                kwonly=tuple(a.arg for a in args.kwonlyargs),
+                has_varargs=args.vararg is not None,
+                annotations=parse_lineage_annotations(ctx, node))
+            summary.consumes |= summary.annotations.consumes
+            self.by_name.setdefault(node.name, []).append(summary)
+            self.by_loc[(_loc_path(ctx.path), node.name,
+                         node.lineno)] = summary
+            if module:
+                self.by_module.setdefault((module, node.name), summary)
+
+    def _fixpoint(self) -> None:
+        for _ in range(self._FIXPOINT_ROUNDS):
+            changed = False
+            for ctx in self.ctxs:
+                scopes = collect_scope_events(ctx, graph=self)
+                for node in ast.walk(ctx.tree):
+                    if not isinstance(node, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    summary = self.by_loc.get(
+                        (_loc_path(ctx.path), node.name, node.lineno))
+                    if summary is None:
+                        continue
+                    events = scopes.get(id(node), [])
+                    consumed = _params_consumed(summary, events)
+                    consumed |= summary.annotations.consumes
+                    consumed -= summary.annotations.passthrough
+                    if consumed != summary.consumes:
+                        summary.consumes = consumed
+                        changed = True
+            if not changed:
+                return
+
+    # -- resolution -----------------------------------------------------
+
+    def summary_for(self, ctx: ModuleContext,
+                    fn: ast.AST) -> Optional[FunctionSummary]:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        return self.by_loc.get(
+            (_loc_path(ctx.path), fn.name, fn.lineno))
+
+    def _candidates(self, ctx: ModuleContext,
+                    call: ast.Call) -> List[FunctionSummary]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id in SAFE_BUILTINS:
+                return []
+            local = ctx.resolve_local(call, func.id)
+            if local is not None:
+                summary = self.summary_for(ctx, local)
+                return [summary] if summary is not None else []
+            imp = self._import_table(ctx).get(func.id)
+            if imp is not None:
+                module, name = imp
+                if not module.startswith("diff3d_tpu"):
+                    return []
+                hit = self.by_module.get((module, name))
+                return [hit] if hit is not None else []
+            return list(self.by_name.get(func.id, ()))
+        if isinstance(func, ast.Attribute):
+            dotted = dotted_name(func.value)
+            if dotted is not None:
+                root = dotted.split(".")[0]
+                if (dotted in ctx.random_aliases
+                        or root in SAFE_CALL_ROOTS):
+                    return []
+            return list(self.by_name.get(func.attr, ()))
+        return []
+
+    def consuming_call_args(
+            self, ctx: ModuleContext,
+            call: ast.Call) -> List[Tuple[str, str]]:
+        """``(arg_name, callee_name)`` for every plain-Name argument of
+        ``call`` that every resolved candidate agrees is a consumed key
+        parameter.  Empty when the callee is unresolved/ambiguous."""
+        cands = self._candidates(ctx, call)
+        if not cands or len(cands) > self.MAX_CANDIDATES:
+            return []
+        out: List[Tuple[str, str]] = []
+        seen: Set[str] = set()
+        mapped = [_map_call_args(call, c) for c in cands]
+        for i, arg in enumerate(call.args):
+            if not isinstance(arg, ast.Name) or arg.id in seen:
+                continue
+            if all(("pos", i) in m and m[("pos", i)] in c.consumes
+                   for m, c in zip(mapped, cands)):
+                out.append((arg.id, cands[0].name))
+                seen.add(arg.id)
+        for kw in call.keywords:
+            if (kw.arg is None or not isinstance(kw.value, ast.Name)
+                    or kw.value.id in seen):
+                continue
+            if all(kw.arg in c.all_params and kw.arg in c.consumes
+                   for c in cands):
+                out.append((kw.value.id, cands[0].name))
+                seen.add(kw.value.id)
+        return out
+
+
+def _loc_path(path: str) -> str:
+    norm = path.replace("\\", "/")
+    idx = norm.rfind("diff3d_tpu/")
+    return norm[idx:] if idx >= 0 else norm
+
+
+def _map_call_args(call: ast.Call,
+                   summary: FunctionSummary) -> Dict[tuple, str]:
+    """positional index -> callee param name (keywords handled by the
+    caller directly)."""
+    out: Dict[tuple, str] = {}
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            break
+        if i < len(summary.params):
+            out[("pos", i)] = summary.params[i]
+        elif not summary.has_varargs:
+            break
+    return out
+
+
+def _params_consumed(summary: FunctionSummary, events) -> Set[str]:
+    """Params consumed before any rebinding (the caller-visible
+    contract: a rebound name no longer aliases the caller's key)."""
+    rebound: Set[str] = set()
+    consumed: Set[str] = set()
+    params = summary.all_params
+    for _, kind, name, _node, _detail in events:
+        if kind == "store":
+            rebound.add(name)
+        elif name in params and name not in rebound:
+            consumed.add(name)
+    return consumed
+
+
+def build_program_graph(
+        sources: Dict[str, str]) -> ProgramGraph:
+    return ProgramGraph(sources)
+
+
+# ---------------------------------------------------------------------
+# runtime witness
+# ---------------------------------------------------------------------
+
+
+class RngWitnessViolation(AssertionError):
+    """Raised by :meth:`RngStreamWitness.check` on a key consumed more
+    than once while the witness was installed."""
+
+
+#: jax.random draws that consume their key argument.
+DRAW_OPS = ("normal", "uniform", "randint", "bernoulli", "categorical",
+            "choice", "permutation", "shuffle", "gamma", "beta",
+            "poisson", "exponential", "laplace", "logistic", "gumbel",
+            "truncated_normal", "dirichlet", "multivariate_normal",
+            "cauchy", "rademacher", "bits")
+
+_SHAPE_ARG_INDEX = {"normal": 1, "uniform": 1, "randint": 1, "bits": 1,
+                    "bernoulli": 2, "truncated_normal": 3}
+_DTYPE_ARG_INDEX = {"normal": 2, "uniform": 2}
+
+
+def _fmt_shape(shape) -> str:
+    if shape is None:
+        return ""
+    try:
+        return str(tuple(int(d) for d in shape))
+    except (TypeError, ValueError):
+        return "[?]"
+
+
+def _fmt_dtype(dtype) -> str:
+    if dtype is None:
+        return ""
+    try:
+        import numpy as np
+
+        return f":{np.dtype(dtype).name}"
+    except TypeError:
+        return ":?"
+
+
+class RngStreamWitness:
+    """Ordered key-derivation events + per-key consumption counts for
+    one traced (or eagerly run) program.
+
+    Keys are tracked by object identity — within one trace every
+    ``jax.random`` result is a distinct tracer, so handing the *same*
+    object to two consuming calls is exactly the linear-resource
+    violation the static rules look for.  The witness pins a reference
+    to every key it sees so ids are never recycled."""
+
+    def __init__(self):
+        self.events: List[str] = []
+        self._key_seq: Dict[int, int] = {}
+        self._counts: Dict[int, int] = {}
+        self._refs: List[object] = []
+        self._violations: List[str] = []
+        self._next = 0
+
+    def _seq_for(self, key) -> int:
+        seq = self._key_seq.get(id(key))
+        if seq is None:
+            self._next += 1
+            seq = self._key_seq[id(key)] = self._next
+            self._refs.append(key)
+        return seq
+
+    def _consume(self, op: str, key) -> None:
+        seq = self._seq_for(key)
+        n = self._counts[seq] = self._counts.get(seq, 0) + 1
+        if n > 1:
+            self._violations.append(
+                f"key #{seq} consumed {n}x — jax.random.{op} reused a "
+                f"key already spent (split it, or jax.random.clone for "
+                f"intentional reuse)")
+
+    def record(self, text: str) -> None:
+        self.events.append(text)
+
+    # -- results --------------------------------------------------------
+
+    def consumption_counts(self) -> Dict[int, int]:
+        return dict(self._counts)
+
+    def violations(self) -> List[str]:
+        return list(self._violations)
+
+    def digest(self) -> str:
+        return stream_digest(self.events)
+
+    def check(self) -> None:
+        if self._violations:
+            raise RngWitnessViolation(
+                f"rng witness found {len(self._violations)} "
+                "violation(s):\n" + "\n".join(self._violations))
+
+    def report(self) -> str:
+        head = (f"rng witness: {len(self.events)} event(s), "
+                f"{len(self._counts)} key(s) consumed, "
+                f"{len(self._violations)} violation(s), "
+                f"digest {self.digest()}")
+        if self._violations:
+            head += "\n" + "\n".join(self._violations)
+        return head
+
+
+def stream_digest(events: Sequence[str]) -> str:
+    return hashlib.sha256("\n".join(events).encode()).hexdigest()
+
+
+def install_rng_witness(witness: Optional[RngStreamWitness] = None):
+    """Monkeypatch the key-consuming ``jax.random`` entry points so
+    every call while installed records a stream event (and consumption
+    accounting).  Returns ``(witness, uninstall)``; ``uninstall`` is
+    idempotent.  Install *after* building models/params and *before*
+    ``.lower()``/running — tracing re-executes the Python body, so the
+    trace IS the stream."""
+    import functools
+
+    import jax.random as jrandom
+
+    w = witness if witness is not None else RngStreamWitness()
+    originals: Dict[str, object] = {}
+
+    def _wrap_draw(name, orig):
+        shape_idx = _SHAPE_ARG_INDEX.get(name)
+        dtype_idx = _DTYPE_ARG_INDEX.get(name)
+
+        @functools.wraps(orig)
+        def wrapped(*args, **kwargs):
+            if args:
+                w._consume(name, args[0])
+            shape = kwargs.get("shape")
+            if (shape is None and shape_idx is not None
+                    and len(args) > shape_idx):
+                shape = args[shape_idx]
+            dtype = kwargs.get("dtype")
+            if (dtype is None and dtype_idx is not None
+                    and len(args) > dtype_idx):
+                dtype = args[dtype_idx]
+            w.record(f"{name}{_fmt_shape(shape)}{_fmt_dtype(dtype)}")
+            return orig(*args, **kwargs)
+
+        return wrapped
+
+    def _wrap_split(orig):
+        @functools.wraps(orig)
+        def wrapped(key, num=2, *args, **kwargs):
+            w._consume("split", key)
+            w.record(f"split[{num if isinstance(num, int) else '?'}]")
+            return orig(key, num, *args, **kwargs)
+
+        return wrapped
+
+    def _wrap_fold_in(orig):
+        @functools.wraps(orig)
+        def wrapped(key, data, *args, **kwargs):
+            w._seq_for(key)   # registered, NOT consumed (derivation)
+            tag = data if isinstance(data, int) else "?"
+            w.record(f"fold_in[{tag}]")
+            return orig(key, data, *args, **kwargs)
+
+        return wrapped
+
+    def _wrap_source(name, orig):
+        @functools.wraps(orig)
+        def wrapped(seed, *args, **kwargs):
+            tag = seed if isinstance(seed, int) else "?"
+            w.record(f"{name}[{tag}]")
+            return orig(seed, *args, **kwargs)
+
+        return wrapped
+
+    def _patch(name, wrapper):
+        orig = getattr(jrandom, name, None)
+        if orig is None or not callable(orig):
+            return
+        originals[name] = orig
+        setattr(jrandom, name, wrapper(orig))
+
+    _patch("split", _wrap_split)
+    _patch("fold_in", _wrap_fold_in)
+    for nm in ("PRNGKey", "key"):
+        _patch(nm, lambda orig, _n=nm: _wrap_source(_n, orig))
+    for nm in DRAW_OPS:
+        _patch(nm, lambda orig, _n=nm: _wrap_draw(_n, orig))
+
+    done: List[bool] = []
+
+    def uninstall() -> None:
+        if done:
+            return
+        done.append(True)
+        for nm, orig in originals.items():
+            setattr(jrandom, nm, orig)
+
+    return w, uninstall
+
+
+# ---------------------------------------------------------------------
+# loader stream probe
+# ---------------------------------------------------------------------
+
+
+class _ProbeDataset:
+    """Stub dataset whose samples fingerprint the per-slot rng stream
+    the loader derives — (chosen index, two 63-bit draws)."""
+
+    def __init__(self, n: int):
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def sample(self, idx, rng):
+        import numpy as np
+
+        return {"idx": np.asarray([idx], np.int64),
+                "probe": rng.integers(0, 2 ** 63 - 1, size=2,
+                                      dtype=np.int64)}
+
+
+def loader_stream_events(*, seed: int = 0, batch_size: int = 2,
+                         num_hosts: int = 2, steps: int = 3,
+                         dataset_len: int = 8) -> List[str]:
+    """Drive the REAL loader seed-derivation path (both sample modes,
+    every host of a ``num_hosts`` partition) and digest the streams.
+    The manifest pins the elasticity contract: the global batch stream
+    is a pure function of ``(seed, step, global_slot)``."""
+    import numpy as np
+
+    from diff3d_tpu.data.loader import InfiniteLoader
+
+    events: List[str] = []
+    for mode in ("iid", "permute"):
+        for host in range(num_hosts):
+            loader = InfiniteLoader(
+                _ProbeDataset(dataset_len), batch_size, seed=seed,
+                host_id=host, num_hosts=num_hosts, num_workers=0,
+                sample_mode=mode)
+            for step in range(steps):
+                batch = loader._batch(step)
+                blob = (np.ascontiguousarray(batch["idx"]).tobytes()
+                        + np.ascontiguousarray(batch["probe"]).tobytes())
+                h = hashlib.sha256(blob).hexdigest()[:12]
+                events.append(
+                    f"loader_{mode}[step={step} host={host}/"
+                    f"{num_hosts} B={batch_size}]#{h}")
+    return events

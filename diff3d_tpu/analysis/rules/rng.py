@@ -13,19 +13,25 @@ Sanctioned patterns stay silent:
     jax.random.normal(k1, ...)             # key itself is never reused
     rng, k = jax.random.split(rng)         # carry update: rng re-stored
     jax.random.fold_in(key, i)             # fold_in derives, not draws
+
+Since ISSUE 15 the scan itself lives in ``analysis/rngflow.py`` and is
+shared with rngcheck's interprocedural RC501/RC502: GL101 is the fast
+single-scope alias (this pass stays pure-AST, no call graph), and the
+cross-function cases — the same key handed to two functions that each
+draw from it — are rngcheck's jurisdiction.  One scanner, disjoint
+jurisdictions: the two tools cannot disagree on a shared case.
 """
 
 from __future__ import annotations
 
-import ast
-from typing import Dict, Iterator, List, Tuple
+from typing import Iterator
 
+from diff3d_tpu.analysis.rngflow import NON_CONSUMING, linear_violations
 from diff3d_tpu.analysis.rules.base import Rule
 from diff3d_tpu.analysis.rules.context import ModuleContext
 
-#: jax.random attrs that do NOT consume their key argument.
-_NON_CONSUMING = {"PRNGKey", "key", "fold_in", "key_data",
-                  "wrap_key_data", "key_impl", "clone", "default_prng_impl"}
+#: Back-compat alias — the canonical set moved to rngflow.
+_NON_CONSUMING = NON_CONSUMING
 
 
 class RngReuseRule(Rule):
@@ -35,60 +41,11 @@ class RngReuseRule(Rule):
     description = ("the same PRNG key is consumed by two jax.random "
                    "calls without a split/reassignment in between")
 
-    def _consuming_call_key(self, ctx: ModuleContext,
-                            node: ast.Call) -> str:
-        """The plain-name key argument of a consuming jax.random call,
-        or '' when the call is not one."""
-        if not isinstance(node.func, ast.Attribute):
-            return ""
-        from diff3d_tpu.analysis.rules.context import dotted_name
-        base = dotted_name(node.func.value)
-        if base not in ctx.random_aliases:
-            return ""
-        if node.func.attr in _NON_CONSUMING:
-            return ""
-        if not node.args:
-            return ""
-        first = node.args[0]
-        return first.id if isinstance(first, ast.Name) else ""
-
     def check(self, ctx: ModuleContext) -> Iterator:
-        # Group consuming calls + stores by enclosing function (None =
-        # module scope), then scan each scope in source order.
-        scopes: Dict[int, List[Tuple[Tuple[int, int], str, str,
-                                     ast.AST]]] = {}
-
-        def scope_key(node):
-            fn = ctx.enclosing_function(node)
-            return id(fn) if fn is not None else 0
-
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Call):
-                key = self._consuming_call_key(ctx, node)
-                if key:
-                    scopes.setdefault(scope_key(node), []).append(
-                        ((node.lineno, node.col_offset + 1), "consume",
-                         key, node))
-            elif isinstance(node, ast.Name) and isinstance(
-                    node.ctx, ast.Store):
-                # Stores sort after same-line consumes (col bumped above)
-                # only via assignment-target position; give stores a
-                # line-end bias so `rng, k = split(rng)` re-arms rng.
-                scopes.setdefault(scope_key(node), []).append(
-                    ((node.lineno, 10_000), "store", node.id, node))
-
-        for events in scopes.values():
-            events.sort(key=lambda e: e[0])
-            consumed_at: Dict[str, int] = {}
-            for _, kind, name, node in events:
-                if kind == "store":
-                    consumed_at.pop(name, None)
-                elif name in consumed_at:
-                    yield self.finding(
-                        ctx, node,
-                        f"PRNG key '{name}' already consumed on line "
-                        f"{consumed_at[name]} — split it (or reassign "
-                        "the carry) before drawing again")
-                    consumed_at[name] = node.lineno
-                else:
-                    consumed_at[name] = node.lineno
+        for v in linear_violations(ctx):
+            yield self.finding(
+                ctx, v.node,
+                f"PRNG key '{v.name}' already consumed on line "
+                f"{v.prev_line} — split it (or reassign the carry) "
+                "before drawing again (cross-function lineage: "
+                "rngcheck RC501)")

@@ -80,6 +80,9 @@ class InfiniteLoader:
         self._pool = (ThreadPoolExecutor(num_workers)
                       if num_workers > 0 else None)
 
+    # rng-lineage: stream(epoch permutation: SeedSequence entropy=(seed,
+    # 0x7065726D) spawn_key=(epoch,) — entropy-disjoint from _batch's
+    # per-sample tree, identical on every host)
     def _epoch_perm(self, epoch: int) -> np.ndarray:
         perm = self._perm_cache.get(epoch)
         if perm is None:
@@ -96,6 +99,11 @@ class InfiniteLoader:
                 del self._perm_cache[old]
         return perm
 
+    # rng-lineage: stream(global-batch seed tree: SeedSequence
+    # entropy=seed spawn_key=(step,) spawned once per GLOBAL slot, host
+    # takes slots [host_id*B, host_id*B+B) — the stream is a pure
+    # function of (seed, step, global_slot), pinned by the 'loader'
+    # manifest under runs/rngcheck/)
     def _batch(self, step: int) -> Dict[str, np.ndarray]:
         # Elasticity determinism: spawn the *global* batch's seed streams
         # (spawn_key depends on step only) and slice this host's

@@ -77,6 +77,11 @@ def make_distill_step(model, cfg: Config, env: MeshEnv | None = None,
         return logsnr_schedule_cosine(t, logsnr_min=dcfg.logsnr_min,
                                       logsnr_max=dcfg.logsnr_max)
 
+    # rng-lineage: keys(rng) passthrough(rng) stream(teacher/student
+    # split: rng is rebound via fold_in(step) before any draw — the
+    # caller's key survives the call — then split once into k_i
+    # (signal-time randint) and k_noise (q_sample normal); teacher
+    # half-steps are deterministic and draw nothing)
     def step_fn(state: TrainState, teacher_params,
                 batch: Dict[str, jnp.ndarray], rng: jax.Array,
                 student_steps: jnp.ndarray

@@ -53,7 +53,12 @@ def test_ring_grads_match(n_shards=4):
                                    atol=1e-4, rtol=1e-4)
 
 
-@pytest.mark.parametrize("n_shards", [2, 4])
+# Tier-1 budget: the 2-shard ring is the degenerate rotation (one
+# exchange) and is superseded in tier 1 by the 4-shard run, which
+# exercises the same values-and-grads equivalence across a longer
+# permutation chain.
+@pytest.mark.parametrize("n_shards", [
+    pytest.param(2, marks=pytest.mark.slow), 4])
 def test_ring_pallas_engine_matches_full_attention(n_shards):
     """Ring attention with the Pallas flash kernel as the local block
     engine (interpret mode off-TPU) — values AND grads vs unsharded."""

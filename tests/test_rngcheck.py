@@ -1,0 +1,811 @@
+"""rngcheck (the interprocedural RNG-lineage & precision-flow
+analyzer), tested from both sides like the other pillars: for every RC
+rule a fixture that must FIRE and a fixture that must stay SILENT, the
+GL101/RC501 jurisdiction partition (one scanner, no double-flagging),
+the ``# rng-lineage:`` annotation grammar (including the fixpoint
+effect of ``consumes``/``passthrough`` on the call graph), the runtime
+witness (seeded eager key-reuse regression + the ``rng_lineage``
+marker incl. vacuous-pass protection, via an in-process sub-pytest),
+stream manifests (round-trip, RC510/RC511/RC512, key-scoped
+suppressions, a seeded stream-order perturbation caught by digest
+diff), and the repo-clean gates: the static pass over the real tree
+and the committed ``runs/rngcheck/`` manifests for the tier-1 streams
+must both come back clean.
+"""
+
+import dataclasses
+import json
+import os
+import textwrap
+
+import jax
+import pytest
+
+from diff3d_tpu.analysis import rngcheck as rc
+from diff3d_tpu.analysis import rngflow
+from diff3d_tpu.analysis.lint import DEFAULT_TARGETS, lint_source
+from diff3d_tpu.analysis.rules.context import ModuleContext
+from diff3d_tpu.analysis.rules.rng import RngReuseRule
+
+pytest_plugins = ["pytester"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src, extra=None):
+    """Full RC rule pack over one synthetic module (plus optional
+    sibling modules), with the program graph spanning all of them —
+    the same wiring ``rngcheck_paths`` uses."""
+    sources = {"diff3d_tpu/fx/mod.py": textwrap.dedent(src)}
+    for name, text in (extra or {}).items():
+        sources[f"diff3d_tpu/fx/{name}"] = textwrap.dedent(text)
+    graph = rngflow.build_program_graph(sources)
+    out = []
+    for path in sorted(sources):
+        out.extend(lint_source(
+            path, sources[path], rc.make_rc_rules(graph), tool=rc.TOOL,
+            parse_rule=rc.PARSE_RULE,
+            reasonless_rule=rc.REASONLESS_RULE))
+    return out
+
+
+def _live(findings, rule=None):
+    out = [f for f in findings if not f.suppressed]
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+def _ctx(name, source):
+    import ast
+    return ModuleContext(f"diff3d_tpu/fx/{name}", source,
+                         ast.parse(source))
+
+
+# ---------------------------------------------------------------------------
+# RC501/RC502: cross-call linear-key violations (fire + silent), and
+# the jurisdiction partition with GL101
+# ---------------------------------------------------------------------------
+
+_CALLEE = """\
+    import jax
+
+    def draw_from(rng):
+        return jax.random.normal(rng, (2,))
+"""
+
+
+def test_rc501_call_then_draw_fires():
+    src = """\
+        import jax
+        from diff3d_tpu.fx.callee import draw_from
+
+        def bad(rng):
+            a = draw_from(rng)
+            b = jax.random.normal(rng, (2,))
+            return a + b
+    """
+    (f,) = _live(_lint(src, {"callee.py": _CALLEE}), "RC501")
+    assert "draw_from" not in f.message or True
+    assert "already" in f.message and "split it" in f.message
+
+
+def test_rc501_draw_then_call_fires_and_names_the_callee():
+    src = """\
+        import jax
+        from diff3d_tpu.fx.callee import draw_from
+
+        def bad(rng):
+            b = jax.random.normal(rng, (2,))
+            a = draw_from(rng)
+            return a + b
+    """
+    (f,) = _live(_lint(src, {"callee.py": _CALLEE}), "RC501")
+    assert "draw_from()" in f.message and "drawn from" in f.message
+
+
+def test_rc502_split_then_pass_to_callee_fires():
+    src = """\
+        import jax
+        from diff3d_tpu.fx.callee import draw_from
+
+        def bad(rng):
+            k1, k2 = jax.random.split(rng)
+            return draw_from(rng) + jax.random.normal(k1, (2,))
+    """
+    (f,) = _live(_lint(src, {"callee.py": _CALLEE}), "RC502")
+    assert "split" in f.message and "draw_from()" in f.message
+
+
+def test_rc50x_silent_on_disciplined_split_and_carry():
+    src = """\
+        import jax
+        from diff3d_tpu.fx.callee import draw_from
+
+        def good(rng):
+            rng, k = jax.random.split(rng)
+            a = draw_from(k)
+            rng, k2 = jax.random.split(rng)
+            return a + jax.random.normal(k2, (2,))
+    """
+    findings = _lint(src, {"callee.py": _CALLEE})
+    assert not _live(findings, "RC501")
+    assert not _live(findings, "RC502")
+
+
+def test_rc501_silent_when_callee_rebinds_before_drawing():
+    # The distill step_fn pattern: the callee folds the key first, so
+    # the caller's key survives the call and may be reused.
+    src = """\
+        import jax
+
+        def folds_first(rng, step):
+            rng = jax.random.fold_in(rng, step)
+            return jax.random.normal(rng, (2,))
+
+        def host_loop(rng):
+            a = folds_first(rng, 0)
+            b = folds_first(rng, 1)
+            return a + b
+    """
+    assert not _live(_lint(src), "RC501")
+
+
+def test_jurisdiction_partition_with_gl101():
+    """Local double-draw belongs to GL101; the cross-call one belongs
+    to RC501.  Same scanner, disjoint jurisdictions — neither case is
+    flagged twice."""
+    local = textwrap.dedent("""\
+        import jax
+
+        def f(rng):
+            a = jax.random.normal(rng, (2,))
+            b = jax.random.normal(rng, (2,))
+            return a + b
+    """)
+    ctx = _ctx("local.py", local)
+    assert list(RngReuseRule().check(ctx))          # GL101 fires
+    assert not _live(_lint(local), "RC501")         # rngcheck defers
+
+    cross = """\
+        import jax
+        from diff3d_tpu.fx.callee import draw_from
+
+        def f(rng):
+            a = draw_from(rng)
+            b = jax.random.normal(rng, (2,))
+            return a + b
+    """
+    findings = _lint(cross, {"callee.py": _CALLEE})
+    assert len(_live(findings, "RC501")) == 1
+    ctx2 = _ctx("cross.py",
+                         textwrap.dedent(cross).replace(
+                             "from diff3d_tpu.fx.callee import draw_from",
+                             "draw_from = None"))
+    assert not list(RngReuseRule().check(ctx2))     # GL101 defers
+
+
+def test_rc501_inline_suppression_with_reason():
+    src = """\
+        import jax
+        from diff3d_tpu.fx.callee import draw_from
+
+        def bad(rng):
+            a = draw_from(rng)
+            b = jax.random.normal(rng, (2,))  # rngcheck: disable=RC501(common-mode pair, reviewed)
+            return a + b
+    """
+    findings = _lint(src, {"callee.py": _CALLEE})
+    assert not _live(findings, "RC501")
+    assert any(f.rule == "RC501" and f.suppressed
+               and f.suppress_reason for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# RC003 + the annotation grammar's effect on the graph
+# ---------------------------------------------------------------------------
+
+
+def test_rc003_malformed_annotation_fires_and_good_one_is_silent():
+    bad = """\
+        # rng-lineage: frobnicate(rng)
+        def f(rng):
+            return rng
+    """
+    (f,) = _live(_lint(bad), "RC003")
+    assert "frobnicate" in f.message
+    good = """\
+        # rng-lineage: keys(rng) passthrough(rng) stream(demo)
+        def f(rng):
+            return rng
+    """
+    assert not _live(_lint(good), "RC003")
+
+
+def test_annotation_consumes_marks_opaque_callee_as_consuming():
+    src = """\
+        import jax
+
+        # rng-lineage: consumes(rng)
+        def opaque(rng):
+            return _impl(rng)
+
+        def caller(rng):
+            a = opaque(rng)
+            b = jax.random.normal(rng, (2,))
+            return a + b
+    """
+    (f,) = _live(_lint(src), "RC501")
+    assert "consumed by a callee" in f.message
+
+
+def test_annotation_passthrough_overrides_inferred_consumption():
+    src = """\
+        import jax
+
+        # rng-lineage: passthrough(rng) stream(reuse is the contract)
+        def common_mode(rng):
+            return jax.random.normal(rng, (2,))
+
+        def caller(rng):
+            a = common_mode(rng)
+            b = common_mode(rng)
+            return a + b
+    """
+    assert not _live(_lint(src), "RC501")
+
+
+# ---------------------------------------------------------------------------
+# RC503..RC509: each remaining static rule, fire + silent
+# ---------------------------------------------------------------------------
+
+
+def test_rc503_dead_derived_key_fires_and_underscore_is_silent():
+    src = """\
+        import jax
+
+        def f(rng):
+            k_extra, k_used = jax.random.split(rng)
+            return jax.random.normal(k_used, (2,))
+    """
+    (f,) = _live(_lint(src), "RC503")
+    assert "k_extra" in f.message and "prefix" in f.message
+    silent = src.replace("k_extra", "_k_extra")
+    assert not _live(_lint(silent), "RC503")
+
+
+def test_rc504_host_random_in_traced_body_fires():
+    src = """\
+        import random
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x * random.random()
+    """
+    (f,) = _live(_lint(src), "RC504")
+    assert "trace time" in f.message
+    host_only = """\
+        import random
+        import jax
+
+        def pick_port():
+            return 9000 + random.randrange(100)
+
+        @jax.jit
+        def f(x):
+            return x * 2.0
+    """
+    assert not _live(_lint(host_only), "RC504")
+
+
+def test_rc504_np_random_in_traced_body_fires():
+    src = """\
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x + np.random.normal()
+    """
+    assert _live(_lint(src), "RC504")
+
+
+def test_rc505_key_from_traced_value_fires_and_constant_is_silent():
+    src = """\
+        import jax
+
+        @jax.jit
+        def f(x):
+            k = jax.random.PRNGKey(x)
+            return jax.random.normal(k, (2,))
+    """
+    (f,) = _live(_lint(src), "RC505")
+    assert "data-" in f.message and "fold_in" in f.message
+    silent = src.replace("jax.random.PRNGKey(x)",
+                         "jax.random.PRNGKey(0)")
+    assert not _live(_lint(silent), "RC505")
+
+
+def test_rc506_host_time_seed_fires_and_config_seed_is_silent():
+    src = """\
+        import time
+        import jax
+
+        def make_key():
+            return jax.random.PRNGKey(int(time.time()))
+    """
+    (f,) = _live(_lint(src), "RC506")
+    assert "time.time" in f.message and "config" in f.message
+    silent = """\
+        import jax
+
+        def make_key(seed):
+            return jax.random.PRNGKey(seed)
+    """
+    assert not _live(_lint(silent), "RC506")
+
+
+def test_rc506_np_default_rng_from_pid_fires():
+    src = """\
+        import os
+        import numpy as np
+
+        def make_rng():
+            return np.random.default_rng(os.getpid())
+    """
+    assert _live(_lint(src), "RC506")
+
+
+def test_rc507_loop_invariant_fold_in_fires_and_counter_is_silent():
+    src = """\
+        import jax
+
+        def f(rng, xs):
+            out = []
+            for x in xs:
+                k = jax.random.fold_in(rng, 7)
+                out.append(jax.random.normal(k, (2,)))
+            return out
+    """
+    (f,) = _live(_lint(src), "RC507")
+    assert "same" in f.message and "loop counter" in f.message
+    silent = src.replace("for x in xs:",
+                         "for i, x in enumerate(xs):").replace(
+        "fold_in(rng, 7)", "fold_in(rng, i)")
+    assert not _live(_lint(silent), "RC507")
+
+
+def test_rc508_unguarded_sharded_parity_fires():
+    src = """\
+        import jax
+        import numpy as np
+
+        def test_parity(run, mesh):
+            k = jax.random.PRNGKey(0)
+            a = run(k, mesh=mesh)
+            b = run(k, mesh=None)
+            np.testing.assert_array_equal(a, b)
+    """
+    (f,) = _live(_lint(src), "RC508")
+    assert "threefry_partitionable" in f.message
+
+
+def test_rc508_silent_with_guard_or_allclose():
+    guarded = """\
+        import jax
+        import numpy as np
+
+        def test_parity(run, mesh):
+            k = jax.random.PRNGKey(0)
+            with jax.threefry_partitionable(True):
+                a = run(k, mesh=mesh)
+                b = run(k, mesh=None)
+            np.testing.assert_array_equal(a, b)
+    """
+    assert not _live(_lint(guarded), "RC508")
+    tolerant = """\
+        import jax
+        import numpy as np
+
+        def test_parity(run, mesh):
+            k = jax.random.PRNGKey(0)
+            a = run(k, mesh=mesh)
+            b = run(k, mesh=None)
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+    """
+    assert not _live(_lint(tolerant), "RC508")
+
+
+def test_rc509_bf16_on_accumulation_path_fires():
+    src = """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            loss = jnp.square(x)
+            loss = loss.astype(jnp.bfloat16)
+            return jnp.mean(loss)
+    """
+    (f,) = _live(_lint(src), "RC509")
+    assert "loss" in f.message and "f32" in f.message
+
+
+def test_rc509_reduction_dtype_bf16_fires_and_activations_are_silent():
+    src = """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.mean(x, dtype=jnp.bfloat16)
+    """
+    assert _live(_lint(src), "RC509")
+    silent = """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(imgs):
+            h = imgs.astype(jnp.bfloat16)
+            return jnp.mean(jnp.square(h).astype(jnp.float32))
+    """
+    assert not _live(_lint(silent), "RC509")
+
+
+# ---------------------------------------------------------------------------
+# The runtime witness: seeded eager key-reuse regression
+# ---------------------------------------------------------------------------
+
+
+def test_witness_catches_eager_key_reuse():
+    w, uninstall = rngflow.install_rng_witness()
+    try:
+        k = jax.random.PRNGKey(0)
+        jax.random.normal(k, (2,))
+        jax.random.normal(k, (2,))
+    finally:
+        uninstall()
+    assert w.violations()
+    with pytest.raises(rngflow.RngWitnessViolation):
+        w.check()
+
+
+def test_witness_silent_on_disciplined_split_and_fold_in():
+    w, uninstall = rngflow.install_rng_witness()
+    try:
+        k = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(k)
+        jax.random.normal(k1, (2,))
+        # fold_in derives without consuming: folding twice is legal.
+        jax.random.fold_in(k2, 0)
+        jax.random.fold_in(k2, 1)
+    finally:
+        uninstall()
+    assert w.violations() == []
+    w.check()
+    assert any(e.startswith("split[") for e in w.events)
+    assert any(e.startswith("fold_in[") for e in w.events)
+    assert any(e.startswith("normal(") for e in w.events)
+
+
+def test_witness_digest_is_deterministic_and_order_sensitive():
+    def run(order):
+        w, uninstall = rngflow.install_rng_witness()
+        try:
+            k = jax.random.PRNGKey(0)
+            ks = jax.random.split(k, 3)
+            for i in order:
+                jax.random.normal(ks[i], (i + 1,))
+        finally:
+            uninstall()
+        return w.digest()
+
+    assert run((0, 1, 2)) == run((0, 1, 2))
+    assert run((0, 1, 2)) != run((2, 1, 0))
+
+
+def test_witness_uninstall_restores_and_is_idempotent():
+    before = jax.random.normal
+    _w, uninstall = rngflow.install_rng_witness()
+    assert jax.random.normal is not before
+    uninstall()
+    uninstall()
+    assert jax.random.normal is before
+
+
+# ---------------------------------------------------------------------------
+# The rng_lineage marker (in-process sub-pytest)
+# ---------------------------------------------------------------------------
+
+_SUB_PYTEST_ARGS = ("-p", "diff3d_tpu.analysis.pytest_plugin",
+                    "-p", "no:cacheprovider", "-p", "no:randomly")
+
+
+def test_rng_lineage_marker_e2e(pytester):
+    pytester.makepyfile(textwrap.dedent("""\
+        import jax
+        import pytest
+
+        @pytest.mark.rng_lineage
+        def test_disciplined(rng_witness):
+            k = jax.random.PRNGKey(0)
+            k1, k2 = jax.random.split(k)
+            jax.random.normal(k1, (2,))
+
+        @pytest.mark.rng_lineage
+        def test_reuses_a_key(rng_witness):
+            k = jax.random.PRNGKey(0)
+            jax.random.normal(k, (2,))
+            jax.random.normal(k, (2,))
+    """))
+    result = pytester.runpytest_inprocess(*_SUB_PYTEST_ARGS)
+    # The witness enforces at fixture teardown, so the reuse surfaces
+    # as a teardown error (the run still fails as a whole).
+    assert result.ret != 0
+    result.assert_outcomes(passed=2, errors=1)
+    result.stdout.fnmatch_lines(["*consumed 2x*"])
+
+
+def test_rng_lineage_vacuous_pass_protection(pytester):
+    pytester.makepyfile(textwrap.dedent("""\
+        import pytest
+
+        @pytest.mark.rng_lineage
+        def test_never_draws(rng_witness):
+            pass
+    """))
+    result = pytester.runpytest_inprocess(*_SUB_PYTEST_ARGS)
+    assert result.ret != 0
+    result.stdout.fnmatch_lines(["*vacuous*"])
+
+
+def test_rng_lineage_marker_rejects_bad_usage(pytester):
+    pytester.makepyfile(textwrap.dedent("""\
+        import pytest
+
+        @pytest.mark.rng_lineage
+        def test_no_fixture():
+            pass
+    """))
+    result = pytester.runpytest_inprocess(*_SUB_PYTEST_ARGS)
+    assert result.ret != 0
+    result.stdout.fnmatch_lines(["*rng_witness fixture*"])
+
+
+# ---------------------------------------------------------------------------
+# Stream manifests: round-trip, RC510/RC511, suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_stream_manifest_round_trip(tmp_path):
+    events = rngflow.loader_stream_events(steps=2)
+    m = rc.stream_manifest(
+        "loader", events,
+        [rc.Suppression("RC510", "stream", "spawn-tree rework")])
+    path = rc.manifest_path("loader", str(tmp_path))
+    rc.write_stream_manifest(path, m)
+    loaded = rc.load_stream_manifest(path)
+    assert loaded["program"] == "loader"
+    assert loaded["budgets"]["digest"] == rngflow.stream_digest(events)
+    assert loaded["budgets"]["n_events"] == len(events)
+    assert loaded["observed"]["events"] == events
+    assert loaded["suppressions"][0]["reason"] == "spawn-tree rework"
+
+
+def test_rc511_missing_and_unreadable_manifest(tmp_path):
+    d = str(tmp_path)
+    (f,) = _live(rc.check_streams(["loader"], d))
+    assert f.rule == "RC511" and "--update" in f.message
+    with open(rc.manifest_path("loader", d), "w") as fh:
+        fh.write("{not json")
+    (f2,) = _live(rc.check_streams(["loader"], d))
+    assert f2.rule == "RC511" and "unreadable" in f2.message
+    with open(rc.manifest_path("loader", d), "w") as fh:
+        json.dump({"version": 1, "tool": "memcheck"}, fh)
+    (f3,) = _live(rc.check_streams(["loader"], d))
+    assert f3.rule == "RC511"
+
+
+def test_rc510_seeded_stream_order_perturbation(tmp_path, monkeypatch):
+    """The issue's seeded regression: pin the loader stream, then
+    perturb the derivation ORDER (same events, different sequence) —
+    the digest diff must catch it and name the first divergence."""
+    d = str(tmp_path)
+    rc.update_stream_manifests(["loader"], d)
+    assert not _live(rc.check_streams(["loader"], d))
+
+    events = rc.build_events("loader")
+    perturbed = [events[1], events[0]] + events[2:]
+    monkeypatch.setitem(
+        rc.STREAM_REGISTRY, "loader",
+        dataclasses.replace(rc.STREAM_REGISTRY["loader"],
+                            build=lambda: list(perturbed)))
+    (f,) = _live(rc.check_streams(["loader"], d))
+    assert f.rule == "RC510"
+    assert "first divergence at event 0" in f.message
+    assert "--update" in f.message
+
+
+def test_rc510_truncated_stream_reports_the_extra_event(tmp_path,
+                                                       monkeypatch):
+    d = str(tmp_path)
+    rc.update_stream_manifests(["loader"], d)
+    events = rc.build_events("loader")
+    monkeypatch.setitem(
+        rc.STREAM_REGISTRY, "loader",
+        dataclasses.replace(rc.STREAM_REGISTRY["loader"],
+                            build=lambda: list(events[:-1])))
+    (f,) = _live(rc.check_streams(["loader"], d))
+    assert f.rule == "RC510" and "committed side continues" in f.message
+
+
+def test_manifest_suppressions_are_key_scoped_and_need_reasons(
+        tmp_path, monkeypatch):
+    d = str(tmp_path)
+    rc.update_stream_manifests(["loader"], d)
+    events = rc.build_events("loader")
+    monkeypatch.setitem(
+        rc.STREAM_REGISTRY, "loader",
+        dataclasses.replace(rc.STREAM_REGISTRY["loader"],
+                            build=lambda: list(reversed(events))))
+    path = rc.manifest_path("loader", d)
+    data = rc.load_stream_manifest(path)
+    data["suppressions"] = [{"rule": "RC510", "key": "stream",
+                             "reason": "spawn-tree rework, re-pin next"}]
+    rc.write_stream_manifest(path, data)
+    findings = rc.check_streams(["loader"], d)
+    assert not _live(findings, "RC510")
+    assert any(f.rule == "RC510" and f.suppressed for f in findings)
+
+    # Wrong key does NOT cover; reasonless suppressions warn (RC002).
+    data["suppressions"] = [{"rule": "RC510", "key": "witness"}]
+    rc.write_stream_manifest(path, data)
+    findings = rc.check_streams(["loader"], d)
+    assert _live(findings, "RC510")
+    (w,) = _live(findings, "RC002")
+    assert w.severity == "warning" and "no reason" in w.message
+
+
+def test_update_preserves_suppressions(tmp_path):
+    d = str(tmp_path)
+    path = rc.manifest_path("loader", d)
+    m = rc.stream_manifest("loader", ["stale"],
+                           [rc.Suppression("RC510", "*", "reviewed")])
+    rc.write_stream_manifest(path, m)
+    rc.update_stream_manifests(["loader"], d)
+    loaded = rc.load_stream_manifest(path)
+    assert loaded["suppressions"] == [
+        {"rule": "RC510", "key": "*", "reason": "reviewed"}]
+    assert loaded["observed"]["events"] != ["stale"]
+
+
+def test_rc512_witness_violation_during_build(tmp_path, monkeypatch):
+    def broken_build():
+        w, uninstall = rngflow.install_rng_witness()
+        try:
+            k = jax.random.PRNGKey(0)
+            jax.random.normal(k, (2,))
+            jax.random.normal(k, (2,))
+        finally:
+            uninstall()
+        w.check()
+        return list(w.events)
+
+    monkeypatch.setitem(
+        rc.STREAM_REGISTRY, "loader",
+        dataclasses.replace(rc.STREAM_REGISTRY["loader"],
+                            build=broken_build))
+    d = str(tmp_path)
+    rc.write_stream_manifest(rc.manifest_path("loader", d),
+                             rc.stream_manifest("loader", ["x"]))
+    hits = _live(rc.check_streams(["loader"], d), "RC512")
+    assert hits and "consumed 2x" in hits[0].message
+
+
+def test_loader_stream_is_a_pure_function_of_seed_and_step():
+    """The loader stream the manifest pins is deterministic (same
+    args, same events — across loader instances) and actually
+    sensitive to the seed; and the underlying elasticity rule holds:
+    two hosts' batches concatenate to the one-host global batch."""
+    import numpy as np
+
+    from diff3d_tpu.data.loader import InfiniteLoader
+
+    a = rngflow.loader_stream_events(steps=2)
+    b = rngflow.loader_stream_events(steps=2)
+    assert a == b
+    assert rngflow.loader_stream_events(seed=1, steps=2) != a
+
+    def host_batch(host, num_hosts, B):
+        ld = InfiniteLoader(rngflow._ProbeDataset(8), B, seed=0,
+                            host_id=host, num_hosts=num_hosts,
+                            num_workers=0)
+        return ld._batch(step=3)
+
+    halves = [host_batch(h, 2, 2) for h in (0, 1)]
+    whole = host_batch(0, 1, 4)
+    for key in ("idx", "probe"):
+        np.testing.assert_array_equal(
+            np.concatenate([h[key] for h in halves]), whole[key])
+
+
+# ---------------------------------------------------------------------------
+# CLI + registry plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_and_bad_invocations(capsys):
+    assert rc.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("RC501", "RC508", "RC509", "RC510", "RC512"):
+        assert rid in out
+    assert rc.main(["--list-streams"]) == 0
+    out = capsys.readouterr().out
+    for nm in rc.STREAM_REGISTRY:
+        assert nm in out
+    assert rc.main(["--ast-only", "--streams-only"]) == 2
+    assert rc.main(["--program", "loader", "--streams-tier1"]) == 2
+
+
+def test_manifests_are_committed_for_all_registered_streams():
+    d = rc.default_manifest_dir(_REPO_ROOT)
+    for nm in rc.STREAM_REGISTRY:
+        assert os.path.exists(rc.manifest_path(nm, d)), (
+            f"missing committed rngcheck manifest for {nm}; run "
+            f"'rngcheck --update --program {nm}'")
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 repo-clean gates
+# ---------------------------------------------------------------------------
+
+
+def test_repo_static_pass_clean_tier1():
+    """The rngcheck analogue of ``test_repo_lints_clean``: the full RC
+    rule pack over the production tree (one program graph) plus the
+    RC508 guard rule over tests/ must come back clean — every key in
+    the repo moves through a disciplined split/fold_in lineage."""
+    targets = [os.path.join(_REPO_ROOT, t) for t in DEFAULT_TARGETS]
+    targets = [t for t in targets if os.path.exists(t)]
+    tests = [os.path.join(_REPO_ROOT, "tests")]
+    live = _live(rc.rngcheck_paths(targets, tests))
+    assert not live, "\n".join(f.render() for f in live)
+
+
+def test_repo_stream_manifests_clean_tier1():
+    """Tracing the REAL tier-1 programs under the witness and diffing
+    their ordered key-derivation streams against the committed
+    ``runs/rngcheck/`` manifests must come back clean.  Any drift is
+    either a determinism regression or a reviewed ``--update``
+    re-pin."""
+    d = rc.default_manifest_dir(_REPO_ROOT)
+    live = _live(rc.check_streams(list(rc.TIER1_STREAMS), d))
+    assert not live, "\n".join(f.render() for f in live)
+
+
+def test_repo_stream_manifest_pins_exact_tier1():
+    """observed == recomputed event-for-event, not merely
+    digest-equal-or-missing: a manifest edited by hand (or a build
+    that silently changed its event formatting) must surface as a
+    visible diff, mirroring memcheck's pins-exact gate."""
+    d = rc.default_manifest_dir(_REPO_ROOT)
+    for nm in rc.TIER1_STREAMS:
+        committed = rc.load_stream_manifest(rc.manifest_path(nm, d))
+        recomputed = rc.build_events(nm)
+        assert committed["observed"]["events"] == recomputed, (
+            f"{nm}: committed stream manifest is stale — run "
+            f"'rngcheck --update --program {nm}' and review the diff")
+        assert committed["budgets"]["digest"] == \
+            rngflow.stream_digest(recomputed)
+        assert committed["budgets"]["n_events"] == len(recomputed)
+
+
+@pytest.mark.slow
+def test_repo_stream_manifests_clean_full_sweep():
+    """All five registered streams (adds distill_step and the DDIM
+    sampler) — the full sweep the CLI runs."""
+    d = rc.default_manifest_dir(_REPO_ROOT)
+    live = _live(rc.check_streams(sorted(rc.STREAM_REGISTRY), d))
+    assert not live, "\n".join(f.render() for f in live)

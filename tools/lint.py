@@ -1,7 +1,7 @@
 """Repo static-analysis gate, runnable as a plain script:
 ``python tools/lint.py``.
 
-Runs ALL FOUR passes as one gate (nonzero exit if any finds anything
+Runs ALL FIVE passes as one gate (nonzero exit if any finds anything
 unsuppressed):
 
   * **graftlint** — the AST pass (rules GL1xx, docs/DESIGN.md §9);
@@ -17,9 +17,15 @@ unsuppressed):
   * **memcheck** — the memory pass over the same tier-1 program set
     (rules MC4xx, docs/DESIGN.md §13): peak-HBM/temp budgets,
     donation-effectiveness verification and scan-invariant recompute
-    ceilings against the manifests under ``runs/memcheck/``.
+    ceilings against the manifests under ``runs/memcheck/``;
+  * **rngcheck** — the RNG-lineage pass (rules RC5xx, docs/DESIGN.md
+    §17): interprocedural linear-key dataflow + seed hygiene +
+    precision flow over the default targets, and the tier-1 stream
+    manifests (ordered key-derivation digests) under
+    ``runs/rngcheck/``.
 
-``--ast-only`` / ``--ir-only`` / ``--lock-only`` / ``--mem-only``
+``--ast-only`` / ``--ir-only`` / ``--lock-only`` / ``--mem-only`` /
+``--rng-only``
 select one pass; all other arguments pass through to the selected pass
 — with multiple passes active only argument-free invocation is
 supported (pass-specific flags differ).  Works from a checkout without
@@ -31,7 +37,8 @@ from __future__ import annotations
 import os
 import sys
 
-_ONLY_FLAGS = ("--ast-only", "--ir-only", "--lock-only", "--mem-only")
+_ONLY_FLAGS = ("--ast-only", "--ir-only", "--lock-only", "--mem-only",
+               "--rng-only")
 
 
 def main() -> int:
@@ -67,6 +74,10 @@ def main() -> int:
         from diff3d_tpu.analysis.memcheck import main as memcheck_main
         rc = max(rc, memcheck_main(
             argv if selected else ["--programs-tier1"]))
+    if selected in (None, "--rng-only"):
+        from diff3d_tpu.analysis.rngcheck import main as rngcheck_main
+        rc = max(rc, rngcheck_main(
+            argv if selected else ["--streams-tier1"]))
     return rc
 
 

@@ -1,0 +1,27 @@
+"""Interprocedural RNG-lineage & precision-flow gate, runnable as a
+plain script: ``python tools/rngcheck.py [--ast-only | --streams-tier1
+| --update | --list-rules | --list-streams]``.
+
+Thin wrapper over ``diff3d_tpu.analysis.rngcheck`` (also installed as
+the ``rngcheck`` console script) so the gate works from a checkout
+without installing the package.  All arguments pass through — see
+``--help`` for the stream registry and manifest workflow, and
+docs/DESIGN.md §17 for policy.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from diff3d_tpu.analysis.rngcheck import main as rngcheck_main
+    return rngcheck_main(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
