@@ -209,6 +209,15 @@ def _run(global_batch: int, n_steps: int, accum: int = 1,
 
         stats["mem"] = (mem_lib.memory_summary(report.memory)
                         if report.memory is not None else None)
+        # equivcheck semantic fingerprint from the SAME lowering: the
+        # canonical digest travels with the perf number, so a recorded
+        # regression can be split into "same program, slower" vs "the
+        # program itself changed" (docs/DESIGN.md §18).
+        from diff3d_tpu.analysis import equiv as equiv_lib
+
+        stats["semantic_fingerprint"] = (
+            equiv_lib.semantic_summary(report.semantic)
+            if report.semantic is not None else None)
     except Exception as e:
         stats["comms"] = {"error": str(e).splitlines()[0][:200]}
     return median, stats
@@ -260,7 +269,8 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
                    steps: int | None = None,
                    comms_out: dict | None = None,
                    mem_out: dict | None = None,
-                   rng_out: dict | None = None):
+                   rng_out: dict | None = None,
+                   sem_out: dict | None = None):
     """Seconds per synthesised view, reference sampler config (256 steps,
     8-weight guidance sweep, ``/root/reference/sampling.py:130-158``) —
     one compiled lax.scan per view.  ``srn128`` runs the full-resolution
@@ -293,7 +303,11 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     same lower+compile pass.  ``rng_out`` is the same contract for the
     rngcheck stream digest (ordered key-derivation events witnessed
     during the lower — ``analysis/rngflow.py``), so bench rounds carry
-    determinism provenance next to comms and memory.
+    determinism provenance next to comms and memory.  ``sem_out`` is
+    the same contract for the equivcheck semantic fingerprint (the
+    canonical-form digest and dead/duplicate estimates —
+    ``analysis/equiv.py``), pinning WHAT program was timed next to how
+    fast it ran.
     """
     import jax
     import numpy as np
@@ -318,8 +332,10 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
                       scan_chunks=chunks, mesh=mesh_env,
                       sampler_kind=sampler_kind, steps=steps)
 
-    if comms_out is not None or mem_out is not None or rng_out is not None:
+    if (comms_out is not None or mem_out is not None
+            or rng_out is not None or sem_out is not None):
         try:
+            from diff3d_tpu.analysis import equiv as equiv_lib
             from diff3d_tpu.analysis import ir as ir_lib
             from diff3d_tpu.analysis import mem as mem_lib
             from diff3d_tpu.analysis.rngflow import install_rng_witness
@@ -341,8 +357,11 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
                 comms_out.update(ir_lib.comms_summary(report))
             if mem_out is not None and report.memory is not None:
                 mem_out.update(mem_lib.memory_summary(report.memory))
+            if sem_out is not None and report.semantic is not None:
+                sem_out.update(
+                    equiv_lib.semantic_summary(report.semantic))
         except Exception as e:
-            for d in (comms_out, mem_out, rng_out):
+            for d in (comms_out, mem_out, rng_out, sem_out):
                 if d is not None:
                     d["error"] = str(e).splitlines()[0][:200]
 
@@ -612,8 +631,10 @@ def _bench_main() -> int:
             comms: dict = {}
             mem: dict = {}
             rng_stream: dict = {}
+            sem: dict = {}
             sec_per_view, raw_s, n_eff = _sampler_bench(
-                comms_out=comms, mem_out=mem, rng_out=rng_stream)
+                comms_out=comms, mem_out=mem, rng_out=rng_stream,
+                sem_out=sem)
             payload["sampler"] = {
                 "metric": f"sampler_sec_per_view_srn64_{platform}",
                 "value": round(sec_per_view, 2),
@@ -625,6 +646,7 @@ def _bench_main() -> int:
                 "comms": comms,
                 "mem": mem,
                 "rng_stream": rng_stream,
+                "semantic_fingerprint": sem,
             }
         except Exception as e:
             payload["sampler"] = {"error": str(e).splitlines()[0][:200]}
@@ -638,10 +660,11 @@ def _bench_main() -> int:
                 sh_comms: dict = {}
                 sh_mem: dict = {}
                 sh_rng: dict = {}
+                sh_sem: dict = {}
                 sh_spv, sh_raw, sh_eff = _sampler_bench(
                     object_batch=ndev, use_mesh=True,
                     comms_out=sh_comms, mem_out=sh_mem,
-                    rng_out=sh_rng)
+                    rng_out=sh_rng, sem_out=sh_sem)
                 payload["sampler"]["sharded"] = {
                     "chips_used": ndev,
                     "sec_per_view": round(sh_spv, 2),
@@ -654,6 +677,7 @@ def _bench_main() -> int:
                     "comms": sh_comms,
                     "mem": sh_mem,
                     "rng_stream": sh_rng,
+                    "semantic_fingerprint": sh_sem,
                 }
             except Exception as e:
                 payload["sampler"]["sharded"] = {

@@ -44,25 +44,17 @@ import json
 import os
 from typing import Dict, List, Optional, Sequence
 
+from diff3d_tpu.analysis import manifests as manifests_lib
 from diff3d_tpu.analysis.ir import ProgramReport
 from diff3d_tpu.analysis.lint import (Finding, SEVERITY_ERROR,
                                       SEVERITY_WARNING)
+from diff3d_tpu.analysis.manifests import Suppression, manifest_path  # noqa: F401 (re-exported API)
 
 #: Default manifest directory, relative to the repo root.
 DEFAULT_MANIFEST_DIR = os.path.join("runs", "shardcheck")
 
 MANIFEST_VERSION = 1
 MANIFEST_TOOL = "shardcheck"
-
-
-@dataclasses.dataclass
-class Suppression:
-    rule: str
-    key: str = "*"
-    reason: Optional[str] = None
-
-    def covers(self, rule: str, key: str) -> bool:
-        return self.rule == rule and self.key in ("*", key)
 
 
 @dataclasses.dataclass
@@ -102,18 +94,9 @@ class Manifest:
         }
 
 
-def manifest_path(program: str, manifest_dir: str) -> str:
-    return os.path.join(manifest_dir, f"{program}.json")
-
-
 def load_manifest(path: str) -> Manifest:
-    with open(path, encoding="utf-8") as f:
-        data = json.load(f)
-    if (not isinstance(data, dict)
-            or data.get("version") != MANIFEST_VERSION
-            or data.get("tool") != MANIFEST_TOOL):
-        raise ValueError(f"{path}: not a shardcheck manifest "
-                         f"(version {MANIFEST_VERSION})")
+    data = manifests_lib.load_manifest_data(
+        path, MANIFEST_TOOL, MANIFEST_VERSION, "shardcheck manifest")
     b = data.get("budgets", {})
     budgets = Budget(
         collectives={str(k): {"count": int(v.get("count", 0)),
@@ -124,10 +107,7 @@ def load_manifest(path: str) -> Manifest:
                        for k, v in b.get("dtype_upcasts", {}).items()},
         host_callbacks=[str(x) for x in b.get("host_callbacks", [])],
         require_param_policy=bool(b.get("require_param_policy", True)))
-    supps = [Suppression(rule=str(s.get("rule", "")),
-                         key=str(s.get("key", "*")),
-                         reason=s.get("reason"))
-             for s in data.get("suppressions", [])]
+    supps = manifests_lib.parse_suppressions(data.get("suppressions", []))
     return Manifest(program=str(data.get("program", "")),
                     mesh={str(k): int(v)
                           for k, v in data.get("mesh", {}).items()},
@@ -137,10 +117,7 @@ def load_manifest(path: str) -> Manifest:
 
 
 def write_manifest(path: str, manifest: Manifest) -> None:
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(manifest.to_json(), f, indent=1, sort_keys=True)
-        f.write("\n")
+    manifests_lib.write_manifest_data(path, manifest.to_json())
 
 
 def manifest_from_report(report: ProgramReport,
@@ -241,24 +218,14 @@ def check_report(report: ProgramReport, manifest: Manifest,
 
 def _apply_suppressions(raw: Sequence[Finding], manifest: Manifest,
                         manifest_file: str, prog: str) -> List[Finding]:
-    out: List[Finding] = []
-    for f in raw:
-        key = (f.fingerprint_data or "").split("\x00")[-1]
-        supp = next((s for s in manifest.suppressions
-                     if s.covers(f.rule, key)), None)
-        if supp is not None:
-            f = dataclasses.replace(f, suppressed=True,
-                                    suppress_reason=supp.reason)
-        out.append(f)
     # Reason-mandatory, like graftlint inline suppressions (GL002).
-    for s in manifest.suppressions:
-        if not s.reason:
-            out.append(_finding(
-                manifest_file, "SC002", prog, f"{s.rule}:{s.key}",
-                f"manifest suppression of {s.rule} (key={s.key!r}) has "
-                f"no reason — every suppression documents why it is "
-                f"safe", severity=SEVERITY_WARNING))
-    return out
+    return manifests_lib.apply_suppressions(
+        raw, manifest.suppressions,
+        lambda s: _finding(
+            manifest_file, "SC002", prog, f"{s.rule}:{s.key}",
+            f"manifest suppression of {s.rule} (key={s.key!r}) has "
+            f"no reason — every suppression documents why it is "
+            f"safe", severity=SEVERITY_WARNING))
 
 
 def missing_manifest_finding(program: str,

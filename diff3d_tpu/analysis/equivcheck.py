@@ -1,0 +1,344 @@
+"""equivcheck — StableHLO semantic-equivalence gate over the repo's
+pjit programs.
+
+The sixth analysis pillar (graftlint AST, shardcheck IR/comms,
+lockcheck concurrency, memcheck memory, rngcheck RNG lineage,
+**equivcheck semantics**).  Like memcheck it has no program registry of
+its own: it rides :data:`~diff3d_tpu.analysis.shardcheck.REGISTRY` and
+the same lower+compile pass — ``ir.analyze_lowered`` attaches a
+:class:`~diff3d_tpu.analysis.equiv.SemanticReport` to every
+:class:`~diff3d_tpu.analysis.ir.ProgramReport` it builds, and this CLI
+diffs those against manifests under ``runs/equivcheck/`` (rules EQ6xx,
+``docs/DESIGN.md`` §18).
+
+A **manifest** pins one program's canonical semantic form: the
+fingerprint digest, the canonical line list (so EQ601 can name the
+first divergent op, not just "something changed"), and ceilings for
+dead outputs and duplicate subcomputation FLOPs.  Suppressions follow
+the same key-scoped, reason-mandatory discipline as the other pillars::
+
+    "suppressions": [
+      {"rule": "EQ604", "key": "duplicate_flops",
+       "reason": "threefry key splits duplicate by construction"}
+    ]
+
+Rules:
+
+  EQ002  manifest suppression without a reason               (warning)
+  EQ601  semantic fingerprint drift (names the divergent op)  (error)
+  EQ602  hoist not verified / refuted by the hoist verifier   (error)
+  EQ603  dead computation feeding no program output           (error)
+  EQ604  duplicate subcomputation FLOPs over budget           (error)
+  EQ605  program has no committed manifest                    (error)
+
+Workflow mirrors memcheck::
+
+    equivcheck                      # check all programs vs manifests
+    equivcheck --programs-tier1     # the tier-1 gate (tools/lint.py)
+    equivcheck --update             # re-pin manifests, keep suppressions
+    equivcheck --program step_many --format json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from diff3d_tpu.analysis import manifests as manifests_lib
+from diff3d_tpu.analysis import shardcheck as shardcheck_lib
+from diff3d_tpu.analysis.equiv import (SemanticReport, semantic_summary,
+                                       structural_diff)
+from diff3d_tpu.analysis.lint import (Finding, SEVERITY_ERROR,
+                                      SEVERITY_WARNING)
+from diff3d_tpu.analysis.manifests import Suppression, manifest_path  # noqa: F401 (re-exported API)
+from diff3d_tpu.analysis.shardcheck import (REGISTRY, TIER1_PROGRAMS,
+                                            ensure_cpu_mesh_devices)
+
+#: Default manifest directory, relative to the repo root.
+DEFAULT_MANIFEST_DIR = os.path.join("runs", "equivcheck")
+
+MANIFEST_VERSION = 1
+MANIFEST_TOOL = "equivcheck"
+
+
+@dataclasses.dataclass
+class EquivBudget:
+    """What a manifest pins.  ``digest`` is an equality pin (semantics
+    either moved or they did not); the FLOP/count fields are ceilings."""
+
+    digest: str = ""
+    n_ops: int = 0
+    duplicate_flops: float = 0.0
+    dead_ops: int = 0
+
+
+@dataclasses.dataclass
+class EquivManifest:
+    program: str
+    budgets: EquivBudget
+    observed: dict = dataclasses.field(default_factory=dict)
+    suppressions: List[Suppression] = dataclasses.field(
+        default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "version": MANIFEST_VERSION,
+            "tool": MANIFEST_TOOL,
+            "program": self.program,
+            "budgets": dataclasses.asdict(self.budgets),
+            "observed": self.observed,
+            "suppressions": [dataclasses.asdict(s)
+                             for s in self.suppressions],
+        }
+
+
+def load_manifest(path: str) -> EquivManifest:
+    data = manifests_lib.load_manifest_data(
+        path, MANIFEST_TOOL, MANIFEST_VERSION, "equivcheck manifest")
+    b = data.get("budgets", {})
+    budgets = EquivBudget(
+        digest=str(b.get("digest", "")),
+        n_ops=int(b.get("n_ops", 0)),
+        duplicate_flops=float(b.get("duplicate_flops", 0.0)),
+        dead_ops=int(b.get("dead_ops", 0)))
+    supps = manifests_lib.parse_suppressions(data.get("suppressions", []))
+    return EquivManifest(program=str(data.get("program", "")),
+                         budgets=budgets,
+                         observed=data.get("observed", {}),
+                         suppressions=supps)
+
+
+def write_manifest(path: str, manifest: EquivManifest) -> None:
+    manifests_lib.write_manifest_data(path, manifest.to_json())
+
+
+def manifest_from_report(report: SemanticReport,
+                         suppressions: Optional[
+                             Sequence[Suppression]] = None
+                         ) -> EquivManifest:
+    """Pin a report: the digest becomes the equality pin, observed
+    dead/duplicate figures become the ceilings."""
+    budgets = EquivBudget(
+        digest=report.digest,
+        n_ops=report.n_ops,
+        duplicate_flops=report.duplicate_flops,
+        dead_ops=len(report.dead_ops))
+    return EquivManifest(program=report.name, budgets=budgets,
+                         observed=report.to_json(),
+                         suppressions=list(suppressions or []))
+
+
+# -- checking ----------------------------------------------------------
+
+
+def _finding(manifest_file: str, rule: str, program: str, key: str,
+             message: str, severity: str = SEVERITY_ERROR) -> Finding:
+    return Finding(
+        path=manifest_file, rule=rule, line=1, col=0, severity=severity,
+        message=f"[{program}] {message}",
+        fingerprint_data=f"{program}\x00{rule}\x00{key}")
+
+
+def check_report(report: SemanticReport, manifest: EquivManifest,
+                 manifest_file: str) -> List[Finding]:
+    """Diff a semantic report against its manifest.  Returns ALL
+    findings (suppressed ones marked), same contract as
+    ``lint_source``."""
+    raw: List[Finding] = []
+    b = manifest.budgets
+    prog = report.name
+
+    if report.available and b.digest and report.digest != b.digest:
+        diff = structural_diff(
+            manifest.observed.get("lines", []), report.lines)
+        raw.append(_finding(
+            manifest_file, "EQ601", prog, "digest",
+            f"semantic fingerprint drifted from pinned "
+            f"{b.digest[:12]} to {report.digest[:12]} — "
+            f"{diff or 'canonical line lists differ'}; if the change "
+            f"is intended, re-pin with 'equivcheck --update'"))
+
+    if report.available and len(report.dead_ops) > b.dead_ops:
+        sample = ", ".join(
+            f"{d.op} ({d.flops:.3g} FLOPs)"
+            for d in report.dead_ops[:3])
+        raw.append(_finding(
+            manifest_file, "EQ603", prog, "dead_ops",
+            f"{len(report.dead_ops)} dead computation(s) feed no "
+            f"program output (budget {b.dead_ops}) — e.g. {sample}; "
+            f"an output was dropped or a refactor orphaned a "
+            f"subgraph"))
+
+    dup = report.duplicate_flops
+    if report.available and dup > b.duplicate_flops:
+        raw.append(_finding(
+            manifest_file, "EQ604", prog, "duplicate_flops",
+            f"duplicate subcomputation estimate {dup:.6g} FLOPs "
+            f"exceeds budget {b.duplicate_flops:.6g} — identical "
+            f"canonical subgraphs are evaluated more than once "
+            f"(static precursor of memcheck's MC404 recompute gate)"))
+
+    return _apply_suppressions(raw, manifest, manifest_file, prog)
+
+
+def _apply_suppressions(raw: Sequence[Finding], manifest: EquivManifest,
+                        manifest_file: str, prog: str) -> List[Finding]:
+    # Reason-mandatory, like the other five pillars.
+    return manifests_lib.apply_suppressions(
+        raw, manifest.suppressions,
+        lambda s: _finding(
+            manifest_file, "EQ002", prog, f"{s.rule}:{s.key}",
+            f"manifest suppression of {s.rule} (key={s.key!r}) has "
+            f"no reason — every suppression documents why it is "
+            f"safe", severity=SEVERITY_WARNING))
+
+
+def missing_manifest_finding(program: str,
+                             manifest_dir: str) -> Finding:
+    path = manifest_path(program, manifest_dir)
+    return _finding(
+        path, "EQ605", program, "missing",
+        f"no committed manifest at {path} — run "
+        f"'equivcheck --update --program {program}' and commit the "
+        f"result")
+
+
+def check_report_against_dir(report: SemanticReport,
+                             manifest_dir: str) -> List[Finding]:
+    """Load ``<dir>/<program>.json`` and check; a missing or unreadable
+    manifest is itself a finding (EQ605)."""
+    path = manifest_path(report.name, manifest_dir)
+    if not os.path.exists(path):
+        return [missing_manifest_finding(report.name, manifest_dir)]
+    try:
+        manifest = load_manifest(path)
+    except (ValueError, json.JSONDecodeError) as e:
+        return [_finding(path, "EQ605", report.name, "unreadable",
+                         f"manifest unreadable: {e}")]
+    return check_report(report, manifest, path)
+
+
+# -- the CLI -----------------------------------------------------------
+
+
+def default_manifest_dir(root: Optional[str] = None) -> str:
+    if root is None:
+        root = shardcheck_lib._find_root()
+    return os.path.join(root, DEFAULT_MANIFEST_DIR)
+
+
+def semantic_report_for(name: str) -> SemanticReport:
+    """Build the registered program (through shardcheck's in-process
+    report cache — all pillars analyze the same compiled programs) and
+    return its semantic report."""
+    report = shardcheck_lib.build_report(name)
+    sem = getattr(report, "semantic", None)
+    if sem is None:
+        # analyze_lowered always attaches one; a None here means an
+        # out-of-band builder — treat as an unavailable report so the
+        # manifest checks still run (and EQ601 stays quiet rather than
+        # firing on an empty digest).
+        sem = SemanticReport(name=name, available=False)
+    return sem
+
+
+def check_programs(names: Sequence[str], manifest_dir: str,
+                   reports_out: Optional[list] = None) -> List[Finding]:
+    """Build + analyze each named program and diff its semantic report
+    against the committed manifest.  Returns ALL findings (suppressed
+    marked), ``lint_source``-style."""
+    findings: List[Finding] = []
+    for nm in names:
+        sem = semantic_report_for(nm)
+        if reports_out is not None:
+            reports_out.append(sem)
+        findings.extend(check_report_against_dir(sem, manifest_dir))
+    return findings
+
+
+def update_manifests(names: Sequence[str], manifest_dir: str) -> List[str]:
+    """Re-pin each named program's manifest from its current semantic
+    report, PRESERVING any suppressions the committed manifest carries
+    (they are reviewed policy, not observations)."""
+    written = []
+    for nm in names:
+        sem = semantic_report_for(nm)
+        path = manifest_path(nm, manifest_dir)
+        supps = manifests_lib.carry_suppressions(path, load_manifest)
+        write_manifest(path, manifest_from_report(sem, supps))
+        written.append(path)
+    return written
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="equivcheck",
+        description="StableHLO semantic-equivalence analyzer over the "
+                    "repo's pjit programs (rules EQ6xx; see "
+                    "docs/DESIGN.md §18)")
+    p.add_argument("--program", action="append", default=None,
+                   choices=sorted(REGISTRY), dest="programs",
+                   help="check one program (repeatable; default: all)")
+    p.add_argument("--programs-tier1", action="store_true",
+                   help=f"check only the tier-1 set {TIER1_PROGRAMS}")
+    p.add_argument("--manifest-dir", default=None,
+                   help="manifest directory (default <root>/"
+                        f"{DEFAULT_MANIFEST_DIR})")
+    p.add_argument("--update", action="store_true",
+                   help="write manifests pinned to the current reports "
+                        "(keeps existing suppressions) and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--show-suppressed", action="store_true")
+    p.add_argument("--list", action="store_true", dest="list_programs",
+                   help="list registered programs")
+    args = p.parse_args(argv)
+
+    if args.list_programs:
+        for spec in REGISTRY.values():
+            tag = " [tier1]" if spec.tier1 else ""
+            print(f"{spec.name:18s} {spec.description}{tag}")
+        return 0
+
+    if args.programs and args.programs_tier1:
+        print("equivcheck: --program and --programs-tier1 are exclusive",
+              file=sys.stderr)
+        return 2
+    names = (args.programs or
+             (list(TIER1_PROGRAMS) if args.programs_tier1
+              else sorted(REGISTRY)))
+    manifest_dir = args.manifest_dir or default_manifest_dir()
+
+    ensure_cpu_mesh_devices()
+
+    if args.update:
+        for path in update_manifests(names, manifest_dir):
+            print(f"equivcheck: wrote {path}")
+        return 0
+
+    reports: list = []
+    findings = check_programs(names, manifest_dir, reports_out=reports)
+    live = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    if args.format == "json":
+        print(json.dumps({
+            "summaries": {r.name: semantic_summary(r) for r in reports},
+            "findings": [dataclasses.asdict(f) for f in findings],
+            "unsuppressed": len(live),
+            "suppressed": len(suppressed),
+        }, indent=1))
+    else:
+        shown = findings if args.show_suppressed else live
+        for f in shown:
+            print(f.render())
+        print(f"equivcheck: {len(live)} finding(s), "
+              f"{len(suppressed)} suppressed, "
+              f"{len(names)} program(s)")
+    return 1 if live else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,6 +154,11 @@ class ProgramReport:
     #: memcheck's :class:`~diff3d_tpu.analysis.mem.MemoryReport` for the
     #: same compiled program (None when analysis was skipped).
     memory: Optional[object] = None
+    #: equivcheck's :class:`~diff3d_tpu.analysis.equiv.SemanticReport`
+    #: for the same lowering (None when analysis was skipped).  Kept out
+    #: of :meth:`to_json` — equivcheck pins its own manifests under
+    #: ``runs/equivcheck/``; shardcheck manifests stay unchanged.
+    semantic: Optional[object] = None
 
     @property
     def total_collective_bytes(self) -> int:
@@ -380,13 +385,19 @@ def analyze_lowered(name: str, lowered, *, params_template=None,
     memory = _mem.build_memory_report(
         name, stablehlo_text, compiled,
         requested=_mem.requested_donations(lowered))
+    # equivcheck rides it too: the canonical semantic fingerprint is a
+    # pure function of the StableHLO text already in hand.
+    from diff3d_tpu.analysis import equiv as _equiv
+
+    semantic = _equiv.build_semantic_report(name, stablehlo_text)
     return ProgramReport(
         name=name, mesh_shape=mesh_shape, collectives=collectives,
         resharding_sites=shlo["resharding_sites"],
         dtype_upcasts=shlo["dtype_upcasts"],
         host_callbacks=sorted(shlo["host_callbacks"]),
         param_table=table, flops=cost["flops"],
-        bytes_accessed=cost["bytes_accessed"], memory=memory)
+        bytes_accessed=cost["bytes_accessed"], memory=memory,
+        semantic=semantic)
 
 
 def analyze_jitted(name: str, fn, *abstract_args, params_template=None,

@@ -1,0 +1,120 @@
+"""Shared manifest machinery for the manifest-backed analysis pillars.
+
+Four pillars pin observations as JSON manifests under ``runs/<tool>/``
+(shardcheck comms budgets, memcheck memory budgets, rngcheck stream
+digests, equivcheck semantic fingerprints).  They share one contract:
+
+  * a manifest is ``{version, tool, program, budgets, observed,
+    suppressions}``, written with ``indent=1, sort_keys=True`` and a
+    trailing newline so diffs are line-stable;
+  * loading validates ``version``/``tool`` and raises ``ValueError``
+    otherwise — an unreadable manifest is a *finding* at the call site,
+    never a crash;
+  * suppressions are key-scoped (``key`` names one subject, ``"*"``
+    covers the rule) and reason-mandatory: a reasonless suppression is
+    itself reported (GL002/SC002/MC002/RC002/EQ002);
+  * ``--update`` re-pins observations but PRESERVES committed
+    suppressions — they are reviewed policy, not observations.
+
+This module is the single implementation of that contract; the pillar
+modules keep their own schemas (budgets differ) and finding factories
+(rule ids and message styles differ) and delegate the shared half here.
+Behavior is pinned by the pillars' existing round-trip tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, List, Optional, Sequence
+
+from diff3d_tpu.analysis.lint import Finding
+
+
+@dataclasses.dataclass
+class Suppression:
+    """One key-scoped manifest suppression.  ``key`` names the subject
+    (a collective op, an arg index, a canonical-op key); ``"*"`` covers
+    the whole rule.  ``reason`` is mandatory policy — enforced by the
+    per-pillar reasonless rule, not here."""
+
+    rule: str
+    key: str = "*"
+    reason: Optional[str] = None
+
+    def covers(self, rule: str, key: str) -> bool:
+        return self.rule == rule and self.key in ("*", key)
+
+
+def parse_suppressions(entries: Sequence[dict]) -> List[Suppression]:
+    """Tolerant ``suppressions`` block -> dataclasses (missing fields
+    get the documented defaults)."""
+    return [Suppression(rule=str(s.get("rule", "")),
+                        key=str(s.get("key", "*")),
+                        reason=s.get("reason"))
+            for s in entries or []]
+
+
+def manifest_path(program: str, manifest_dir: str) -> str:
+    return os.path.join(manifest_dir, f"{program}.json")
+
+
+def load_manifest_data(path: str, tool: str, version: int,
+                       kind: str) -> dict:
+    """Load + validate the shared envelope; ``kind`` is the human name
+    used in the error (e.g. ``"shardcheck manifest"``)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if (not isinstance(data, dict)
+            or data.get("version") != version
+            or data.get("tool") != tool):
+        raise ValueError(f"{path}: not a {kind} (version {version})")
+    return data
+
+
+def write_manifest_data(path: str, data: dict) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def apply_suppressions(
+        findings: Sequence[Finding], supps: Sequence[Suppression],
+        make_reasonless: Callable[[Suppression], Finding]
+) -> List[Finding]:
+    """Mark each finding whose ``(rule, key)`` a suppression covers
+    (key = the last ``\\x00`` field of ``fingerprint_data``), then
+    report every reasonless suppression via ``make_reasonless`` (the
+    pillar supplies its own rule id / message style)."""
+    out: List[Finding] = []
+    for f in findings:
+        key = (f.fingerprint_data or "").split("\x00")[-1]
+        supp = next((s for s in supps if s.covers(f.rule, key)), None)
+        if supp is not None:
+            f = dataclasses.replace(f, suppressed=True,
+                                    suppress_reason=supp.reason)
+        out.append(f)
+    for s in supps:
+        if not s.reason:
+            out.append(make_reasonless(s))
+    return out
+
+
+def carry_suppressions(path: str, loader: Callable[[str], object]) -> list:
+    """The ``--update`` half of the contract: committed suppressions
+    survive a re-pin.  ``loader`` is the pillar's manifest loader; an
+    unreadable/absent manifest carries nothing (the re-pin starts
+    clean).  Returns whatever suppression list the loaded manifest
+    holds — dataclasses for the dataclass-manifest pillars, parsed
+    entries for the dict-manifest ones."""
+    if not os.path.exists(path):
+        return []
+    try:
+        loaded = loader(path)
+    except (ValueError, json.JSONDecodeError):
+        return []
+    if isinstance(loaded, dict):
+        return parse_suppressions(loaded.get("suppressions", []))
+    return list(getattr(loaded, "suppressions", []))

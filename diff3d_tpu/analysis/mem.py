@@ -63,9 +63,11 @@ _MOVEMENT_OPS = frozenset({
 _TENSOR_RE = re.compile(r"tensor<([^>]*)>")
 _VAR_RE = re.compile(r"%[\w.#]+")
 # `%4:3 = stablehlo.while(` / `%8 = stablehlo.add` / `stablehlo.return`
+# / generic-syntax region ops like `%88 = "stablehlo.scatter"(...) ({`
 _STMT_RE = re.compile(
     r"^\s*(?:(%[\w.]+)(?::(\d+))?\s*=\s*)?"
-    r"(stablehlo\.\w+|func\.call|call|chlo\.\w+|return)\b(.*)$")
+    r"((?:\"stablehlo\.\w+\")"
+    r"|(?:stablehlo\.\w+|func\.call|call|chlo\.\w+|return)\b)(.*)$")
 _CALLEE_RE = re.compile(r"@([\w.\"]+)")
 _FUNC_RE = re.compile(r"^\s*func\.func\s+(?:public|private)?\s*@([\w.\"]+)"
                       r"\((.*)$")
@@ -253,6 +255,10 @@ class _Func:
     args: List[str]               # "%arg0", ...
     stmts: List[_Stmt]
     ret: List[str]                # returned value tokens (base names)
+    #: returned tokens with "#k" tuple suffixes intact — the invariance
+    #: pass compares base names, but equiv's value-numbering needs the
+    #: exact element (``%4#1`` vs ``%4#0`` are different values).
+    ret_full: List[str] = dataclasses.field(default_factory=list)
 
 
 def _base(tok: str) -> str:
@@ -289,27 +295,30 @@ def parse_functions(txt: str) -> Dict[str, _Func]:
         fname = m.group(1).strip('"')
         args = [f"%arg{k}" for k in
                 range(len(re.findall(r"%arg\d+:", lines[i])))]
-        stmts, ret, i = _parse_region(lines, i + 1, base_indent=None)
-        funcs[fname] = _Func(fname, args, stmts, ret)
+        stmts, ret, ret_full, i = _parse_region(lines, i + 1,
+                                                base_indent=None)
+        funcs[fname] = _Func(fname, args, stmts, ret, ret_full)
     return funcs
 
 
 def _parse_region(lines: List[str], i: int, base_indent) -> tuple:
     """Parse statements until the region's closing ``}``.  Returns
-    ``(stmts, return_tokens, next_line_index)``."""
+    ``(stmts, return_tokens, full_return_tokens, next_line_index)``."""
     stmts: List[_Stmt] = []
     ret: List[str] = []
+    ret_full: List[str] = []
     n = len(lines)
     while i < n:
         raw = lines[i]
         s = raw.strip()
         if s == "}" or s.startswith("}"):
-            return stmts, ret, i + 1
+            return stmts, ret, ret_full, i + 1
         m = _STMT_RE.match(raw)
         if not m:
             i += 1
             continue
         lhs, _nres, op, rest = m.groups()
+        op = op.strip('"')
         opname = op.split(".")[-1] if op.startswith("stablehlo.") else op
         if opname == "while":
             # operands: the iterArg bindings' RHS values.
@@ -327,29 +336,47 @@ def _parse_region(lines: List[str], i: int, base_indent) -> tuple:
             while i < n and not lines[i].strip().startswith("} do"):
                 cond_lines.append(lines[i])
                 i += 1
-            body, bret, i = _parse_region(lines, i + 1, None)
+            body, bret, bret_full, i = _parse_region(lines, i + 1, None)
             st = _Stmt(lhs=lhs, op="while", operands=inits,
                        result_types=types, callee=None, line=raw,
                        body=body)
             st.iter_args = iter_args            # type: ignore[attr-defined]
             st.body_ret = bret                  # type: ignore[attr-defined]
+            st.body_ret_full = bret_full        # type: ignore[attr-defined]
             st.cond_lines = cond_lines          # type: ignore[attr-defined]
             stmts.append(st)
             continue
         if opname in ("return",):
-            ret = [_base(t) for t in _VAR_RE.findall(rest)]
+            ret_full = list(_VAR_RE.findall(rest))
+            ret = [_base(t) for t in ret_full]
             i += 1
             continue
         callee = None
         if opname in ("func.call", "call"):
             cm = _CALLEE_RE.search(rest)
             callee = cm.group(1).strip('"') if cm else None
-        stmts.append(_Stmt(
+        st = _Stmt(
             lhs=lhs, op=opname,
             operands=[_base(t) for t in _VAR_RE.findall(rest)],
-            result_types=_line_types(raw), callee=callee, line=raw))
+            result_types=_line_types(raw), callee=callee, line=raw)
+        stmts.append(st)
         i += 1
-    return stmts, ret, i
+        # Generic-syntax region ops (`"stablehlo.scatter"(...) ({ ... })`)
+        # carry an anonymous block whose `stablehlo.return` belongs to the
+        # reducer/comparator, not to this region — consume through the
+        # matching `})` so neither the block body nor its closer is taken
+        # for region-level syntax.  The skipped lines ride on the stmt so
+        # downstream analyzers can still fingerprint the block.
+        if "({" in raw and raw.count("({") > raw.count("})"):
+            depth_r = raw.count("({") - raw.count("})")
+            region: List[str] = []
+            while i < n and depth_r > 0:
+                depth_r += lines[i].count("({") - lines[i].count("})")
+                if depth_r > 0:
+                    region.append(lines[i])
+                i += 1
+            st.region_lines = region            # type: ignore[attr-defined]
+    return stmts, ret, ret_full, i
 
 
 # -- FLOP estimation ---------------------------------------------------

@@ -52,8 +52,10 @@ import json
 import os
 from typing import Dict, List, Optional, Sequence
 
+from diff3d_tpu.analysis import manifests as manifests_lib
 from diff3d_tpu.analysis.lint import (Finding, SEVERITY_ERROR,
                                       SEVERITY_WARNING)
+from diff3d_tpu.analysis.manifests import Suppression, manifest_path  # noqa: F401 (re-exported API)
 from diff3d_tpu.analysis.mem import MemoryReport
 
 #: Default manifest directory, relative to the repo root.
@@ -61,16 +63,6 @@ DEFAULT_MANIFEST_DIR = os.path.join("runs", "memcheck")
 
 MANIFEST_VERSION = 1
 MANIFEST_TOOL = "memcheck"
-
-
-@dataclasses.dataclass
-class Suppression:
-    rule: str
-    key: str = "*"
-    reason: Optional[str] = None
-
-    def covers(self, rule: str, key: str) -> bool:
-        return self.rule == rule and self.key in ("*", key)
 
 
 @dataclasses.dataclass
@@ -108,18 +100,9 @@ class MemManifest:
         }
 
 
-def manifest_path(program: str, manifest_dir: str) -> str:
-    return os.path.join(manifest_dir, f"{program}.json")
-
-
 def load_manifest(path: str) -> MemManifest:
-    with open(path, encoding="utf-8") as f:
-        data = json.load(f)
-    if (not isinstance(data, dict)
-            or data.get("version") != MANIFEST_VERSION
-            or data.get("tool") != MANIFEST_TOOL):
-        raise ValueError(f"{path}: not a memcheck manifest "
-                         f"(version {MANIFEST_VERSION})")
+    data = manifests_lib.load_manifest_data(
+        path, MANIFEST_TOOL, MANIFEST_VERSION, "memcheck manifest")
     b = data.get("budgets", {})
     budgets = MemBudget(
         peak_bytes=int(b.get("peak_bytes", 0)),
@@ -128,10 +111,7 @@ def load_manifest(path: str) -> MemManifest:
             b.get("hoistable_flops_per_step", 0.0)),
         effective_donations=[int(x)
                              for x in b.get("effective_donations", [])])
-    supps = [Suppression(rule=str(s.get("rule", "")),
-                         key=str(s.get("key", "*")),
-                         reason=s.get("reason"))
-             for s in data.get("suppressions", [])]
+    supps = manifests_lib.parse_suppressions(data.get("suppressions", []))
     return MemManifest(program=str(data.get("program", "")),
                        budgets=budgets,
                        observed=data.get("observed", {}),
@@ -139,10 +119,7 @@ def load_manifest(path: str) -> MemManifest:
 
 
 def write_manifest(path: str, manifest: MemManifest) -> None:
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(manifest.to_json(), f, indent=1, sort_keys=True)
-        f.write("\n")
+    manifests_lib.write_manifest_data(path, manifest.to_json())
 
 
 def manifest_from_report(report: MemoryReport,
@@ -223,24 +200,14 @@ def check_report(report: MemoryReport, manifest: MemManifest,
 
 def _apply_suppressions(raw: Sequence[Finding], manifest: MemManifest,
                         manifest_file: str, prog: str) -> List[Finding]:
-    out: List[Finding] = []
-    for f in raw:
-        key = (f.fingerprint_data or "").split("\x00")[-1]
-        supp = next((s for s in manifest.suppressions
-                     if s.covers(f.rule, key)), None)
-        if supp is not None:
-            f = dataclasses.replace(f, suppressed=True,
-                                    suppress_reason=supp.reason)
-        out.append(f)
     # Reason-mandatory, like graftlint/shardcheck suppressions.
-    for s in manifest.suppressions:
-        if not s.reason:
-            out.append(_finding(
-                manifest_file, "MC002", prog, f"{s.rule}:{s.key}",
-                f"manifest suppression of {s.rule} (key={s.key!r}) has "
-                f"no reason — every suppression documents why it is "
-                f"safe", severity=SEVERITY_WARNING))
-    return out
+    return manifests_lib.apply_suppressions(
+        raw, manifest.suppressions,
+        lambda s: _finding(
+            manifest_file, "MC002", prog, f"{s.rule}:{s.key}",
+            f"manifest suppression of {s.rule} (key={s.key!r}) has "
+            f"no reason — every suppression documents why it is "
+            f"safe", severity=SEVERITY_WARNING))
 
 
 def missing_manifest_finding(program: str,

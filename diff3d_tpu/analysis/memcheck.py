@@ -77,16 +77,13 @@ def update_manifests(names: Sequence[str], manifest_dir: str) -> List[str]:
     """Re-pin each named program's manifest from its current memory
     report, PRESERVING any suppressions the committed manifest carries
     (they are reviewed policy, not observations)."""
+    from diff3d_tpu.analysis import manifests as manifests_lib
     written = []
     for nm in names:
         mem = memory_report_for(nm)
         path = membudgets_lib.manifest_path(nm, manifest_dir)
-        supps: list = []
-        if os.path.exists(path):
-            try:
-                supps = membudgets_lib.load_manifest(path).suppressions
-            except (ValueError, json.JSONDecodeError):
-                pass
+        supps = manifests_lib.carry_suppressions(
+            path, membudgets_lib.load_manifest)
         membudgets_lib.write_manifest(
             path, membudgets_lib.manifest_from_report(mem, supps))
         written.append(path)

@@ -48,6 +48,14 @@ package — pytest resolves the module off ``sys.path``).  Exposes:
     live.  The test must request the ``rng_witness`` fixture, and a
     marked test under which no ``jax.random`` event was ever recorded
     fails — the check would pass vacuously.
+  * ``@pytest.mark.semantic_pin`` — the test's analyzed programs
+    (semantic reports registered with the ``equiv_check`` fixture) are
+    diffed against the committed ``runs/equivcheck/`` manifests at
+    teardown; any unsuppressed EQ6xx finding (fingerprint drift, dead
+    output, duplicate subcomputation, missing manifest) fails the
+    test.  Point ``equiv_check.manifest_dir`` somewhere else to pin
+    against a test-local manifest set.  Same vacuous-pass protection:
+    a marked test that never registers a report fails.
 """
 
 from __future__ import annotations
@@ -153,6 +161,39 @@ class MemCheck:
         return out
 
 
+class EquivCheck:
+    """Accumulates :class:`~diff3d_tpu.analysis.equiv.SemanticReport`s
+    for the ``semantic_pin`` marker.  ``add`` takes a ready report;
+    ``analyze`` canonicalizes a lowered program (or raw StableHLO text)
+    in place.  ``manifest_dir`` defaults to the repo's committed
+    ``runs/equivcheck/`` and is overridable per test."""
+
+    def __init__(self):
+        self.reports = []
+        self.manifest_dir = None
+
+    def add(self, report):
+        self.reports.append(report)
+        return report
+
+    def analyze(self, name: str, lowered):
+        from diff3d_tpu.analysis.equiv import build_semantic_report
+
+        text = lowered if isinstance(lowered, str) else lowered.as_text()
+        return self.add(build_semantic_report(name, text))
+
+    def findings(self) -> list:
+        """Unsuppressed EQ6xx findings over every registered report,
+        diffed against ``manifest_dir``."""
+        from diff3d_tpu.analysis import equivcheck as equivcheck_lib
+
+        d = self.manifest_dir or equivcheck_lib.default_manifest_dir()
+        out = []
+        for r in self.reports:
+            out.extend(equivcheck_lib.check_report_against_dir(r, d))
+        return [f for f in out if not f.suppressed]
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
@@ -183,6 +224,12 @@ def pytest_configure(config):
         "rng_lineage: run the test with the RNG stream witness "
         "installed (via the rng_witness fixture); fails at teardown "
         "on any jax.random key consumed more than once")
+    config.addinivalue_line(
+        "markers",
+        "semantic_pin: the programs analyzed via the equiv_check "
+        "fixture are diffed against the committed equivcheck "
+        "manifests at teardown; any unsuppressed EQ6xx finding fails "
+        "the test")
 
 
 @pytest.hookimpl(tryfirst=True)
@@ -264,6 +311,18 @@ def pytest_runtest_setup(item):
                 "rng_witness fixture — request it so the witness is "
                 "installed around the test body", pytrace=False)
 
+    marker = item.get_closest_marker("semantic_pin")
+    if marker is not None:
+        if marker.args or marker.kwargs:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.semantic_pin takes no "
+                "arguments", pytrace=False)
+        if "equiv_check" not in item.fixturenames:
+            pytest.fail(
+                f"{item.nodeid}: @pytest.mark.semantic_pin requires "
+                "the equiv_check fixture — request it and analyze the "
+                "lowered programs under test", pytrace=False)
+
 
 @pytest.fixture
 def compile_sentinel(request):
@@ -321,6 +380,27 @@ def mem_check(request):
         pytest.fail(
             f"{request.node.nodeid}: memory budget exceeded over "
             f"[{names}]:\n  " + "\n  ".join(violations), pytrace=False)
+
+
+@pytest.fixture
+def equiv_check(request):
+    check = EquivCheck()
+    yield check
+    marker = request.node.get_closest_marker("semantic_pin")
+    if marker is None:
+        return
+    if not check.reports:
+        pytest.fail(
+            f"{request.node.nodeid}: @pytest.mark.semantic_pin but no "
+            "program was analyzed — the pin would pass vacuously; call "
+            "equiv_check.analyze(name, lowered) or equiv_check.add(r)",
+            pytrace=False)
+    findings = check.findings()
+    if findings:
+        pytest.fail(
+            f"{request.node.nodeid}: semantic pin violated "
+            f"({len(findings)} finding(s)):\n  "
+            + "\n  ".join(f.render() for f in findings), pytrace=False)
 
 
 @pytest.fixture

@@ -64,6 +64,7 @@ import re
 import sys
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Set
 
+from diff3d_tpu.analysis import manifests as manifests_lib
 from diff3d_tpu.analysis import rngflow
 from diff3d_tpu.analysis.lint import (DEFAULT_TARGETS, Finding,
                                       SEVERITY_ERROR, SEVERITY_WARNING,
@@ -542,14 +543,11 @@ def rngcheck_paths(targets: Sequence[str],
 # ---------------------------------------------------------------------
 
 
-@dataclasses.dataclass
-class Suppression:
-    rule: str
-    key: str = "*"
-    reason: Optional[str] = None
-
-    def covers(self, rule: str, key: str) -> bool:
-        return self.rule == rule and self.key in ("*", key)
+# The shared manifest contract (envelope validation, key-scoped
+# reason-mandatory suppressions, suppression-preserving --update) lives
+# in analysis/manifests.py; the dataclass is re-exported so callers
+# keep constructing ``rngcheck.Suppression``.
+Suppression = manifests_lib.Suppression
 
 
 @dataclasses.dataclass(frozen=True)
@@ -702,30 +700,16 @@ def stream_manifest(program: str, events: Sequence[str],
 
 
 def load_stream_manifest(path: str) -> dict:
-    with open(path, encoding="utf-8") as f:
-        data = json.load(f)
-    if (not isinstance(data, dict)
-            or data.get("version") != MANIFEST_VERSION
-            or data.get("tool") != TOOL):
-        raise ValueError(f"{path}: not a rngcheck stream manifest "
-                         f"(version {MANIFEST_VERSION})")
-    return data
+    return manifests_lib.load_manifest_data(
+        path, TOOL, MANIFEST_VERSION, "rngcheck stream manifest")
 
 
 def write_stream_manifest(path: str, manifest: dict) -> None:
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(manifest, f, indent=1, sort_keys=True)
-        f.write("\n")
+    manifests_lib.write_manifest_data(path, manifest)
 
 
 def _manifest_suppressions(data: dict) -> List[Suppression]:
-    out = []
-    for s in data.get("suppressions", []):
-        out.append(Suppression(rule=str(s.get("rule", "")),
-                               key=str(s.get("key", "*")),
-                               reason=s.get("reason")))
-    return out
+    return manifests_lib.parse_suppressions(data.get("suppressions", []))
 
 
 def _stream_finding(program: str, rule: str, key: str,
@@ -740,24 +724,14 @@ def _stream_finding(program: str, rule: str, key: str,
 def _apply_stream_suppressions(
         findings: List[Finding], supps: Sequence[Suppression],
         program: str, path: str) -> List[Finding]:
-    out: List[Finding] = []
-    for f in findings:
-        key = (f.fingerprint_data or "").split("\x00")[-1]
-        for s in supps:
-            if s.covers(f.rule, key):
-                f = dataclasses.replace(f, suppressed=True,
-                                        suppress_reason=s.reason)
-                break
-        out.append(f)
-    for s in supps:
-        if not s.reason:
-            out.append(_stream_finding(
-                program, REASONLESS_RULE, f"{s.rule}:{s.key}",
-                f"manifest suppression of {s.rule} (key "
-                f"'{s.key}') has no reason — suppressions are "
-                "reviewed policy, write why it is safe",
-                path=path, severity=SEVERITY_WARNING))
-    return out
+    return manifests_lib.apply_suppressions(
+        findings, supps,
+        lambda s: _stream_finding(
+            program, REASONLESS_RULE, f"{s.rule}:{s.key}",
+            f"manifest suppression of {s.rule} (key "
+            f"'{s.key}') has no reason — suppressions are "
+            "reviewed policy, write why it is safe",
+            path=path, severity=SEVERITY_WARNING))
 
 
 def _first_divergence(committed: Sequence[str],
@@ -840,13 +814,8 @@ def update_stream_manifests(names: Sequence[str],
     written = []
     for nm in names:
         path = manifest_path(nm, manifest_dir)
-        supps: List[Suppression] = []
-        if os.path.exists(path):
-            try:
-                supps = _manifest_suppressions(
-                    load_stream_manifest(path))
-            except (ValueError, json.JSONDecodeError):
-                pass
+        supps = manifests_lib.carry_suppressions(
+            path, load_stream_manifest)
         write_stream_manifest(
             path, stream_manifest(nm, build_events(nm), supps))
         written.append(path)

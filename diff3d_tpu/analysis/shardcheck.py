@@ -293,16 +293,13 @@ def update_manifests(names: Sequence[str], manifest_dir: str) -> List[str]:
     """Re-pin each named program's manifest from its current report,
     PRESERVING any suppressions the committed manifest carries (they are
     reviewed policy, not observations)."""
+    from diff3d_tpu.analysis import manifests as manifests_lib
     written = []
     for nm in names:
         report = build_report(nm)
         path = budgets_lib.manifest_path(nm, manifest_dir)
-        supps: list = []
-        if os.path.exists(path):
-            try:
-                supps = budgets_lib.load_manifest(path).suppressions
-            except (ValueError, json.JSONDecodeError):
-                pass
+        supps = manifests_lib.carry_suppressions(
+            path, budgets_lib.load_manifest)
         budgets_lib.write_manifest(
             path, budgets_lib.manifest_from_report(report, supps))
         written.append(path)

@@ -1,0 +1,653 @@
+"""equivcheck (the StableHLO semantic-equivalence pillar), tested from
+both sides like the other five: for every invariance the canonicalizer
+promises, a pair of programs that must FINGERPRINT EQUAL and a mutation
+that must NOT — on synthetic StableHLO for the rewrite rules, and on
+real lowered programs for the end-to-end path.  Then the seeded
+regressions the issue demands (a single-op mutation of the live
+``step_many`` firing EQ601 with the divergent op named; a correct
+scan-hoist certified and two broken ones refuted with EQ602), the
+manifest round-trip + EQ605 + suppression grammar, the
+``semantic_pin`` marker (incl. vacuous-pass protection via an
+in-process sub-pytest), the ``tools/lint.py`` six-gate/--json
+plumbing, the EQ604-vs-MC404 cross-pillar agreement gate, and the
+repo-clean gate: the committed manifests under ``runs/equivcheck/``
+must match what the current tree lowers.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from diff3d_tpu.analysis import equiv
+from diff3d_tpu.analysis import equivcheck as eqc
+from diff3d_tpu.analysis import membudgets as mb
+from diff3d_tpu.analysis import memcheck as mc
+from diff3d_tpu.analysis import shardcheck as sc
+from diff3d_tpu.analysis.equivcheck import (EquivBudget, Suppression,
+                                            check_report,
+                                            check_report_against_dir,
+                                            load_manifest,
+                                            manifest_from_report,
+                                            manifest_path, write_manifest)
+from diff3d_tpu.analysis.pytest_plugin import EquivCheck
+
+pytest_plugins = ["pytester"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _live(findings, rule=None):
+    out = [f for f in findings if not f.suppressed]
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+def _module(body, sig="(%arg0: tensor<8x8xf32>, %arg1: tensor<8x8xf32>)"
+                      " -> (tensor<8x8xf32>)"):
+    return (f"module @jit_f {{\n  func.func public @main{sig} {{\n"
+            + textwrap.indent(textwrap.dedent(body), "    ")
+            + "  }\n}\n")
+
+
+_BASE = _module("""\
+    %0 = stablehlo.add %arg0, %arg1 : tensor<8x8xf32>
+    %1 = stablehlo.subtract %0, %arg1 : tensor<8x8xf32>
+    %2 = stablehlo.multiply %1, %0 : tensor<8x8xf32>
+    return %2 : tensor<8x8xf32>
+""")
+
+
+# ---------------------------------------------------------------------------
+# Canonicalizer invariances on synthetic StableHLO
+# ---------------------------------------------------------------------------
+
+
+def test_alpha_renaming_is_invisible():
+    renamed = _BASE.replace("%0", "%40").replace("%1", "%51") \
+                   .replace("%2", "%62")
+    a = equiv.canonicalize("p", _BASE)
+    b = equiv.canonicalize("p", renamed)
+    assert a.available and a.digest and a.digest == b.digest
+    assert a.lines == b.lines
+    # SSA names never leak into the canonical form.
+    assert not any("%arg" in l or "%0" in l for l in a.lines)
+
+
+def test_commutative_operands_sort_noncommutative_do_not():
+    swapped = _BASE.replace("stablehlo.add %arg0, %arg1",
+                            "stablehlo.add %arg1, %arg0")
+    assert (equiv.canonicalize("p", _BASE).digest
+            == equiv.canonicalize("p", swapped).digest)
+    resub = _BASE.replace("stablehlo.subtract %0, %arg1",
+                          "stablehlo.subtract %arg1, %0")
+    assert (equiv.canonicalize("p", _BASE).digest
+            != equiv.canonicalize("p", resub).digest)
+
+
+def test_identity_reshape_and_convert_fold_away():
+    padded = _module("""\
+        %0 = stablehlo.add %arg0, %arg1 : tensor<8x8xf32>
+        %1 = stablehlo.subtract %0, %arg1 : tensor<8x8xf32>
+        %5 = stablehlo.reshape %1 : (tensor<8x8xf32>) -> tensor<8x8xf32>
+        %6 = stablehlo.convert %5 : tensor<8x8xf32>
+        %2 = stablehlo.multiply %6, %0 : tensor<8x8xf32>
+        return %2 : tensor<8x8xf32>
+    """)
+    a = equiv.canonicalize("p", _BASE)
+    b = equiv.canonicalize("p", padded)
+    assert a.digest == b.digest and a.n_ops == b.n_ops == 3
+    # A reshape that actually changes the type must NOT fold.
+    real = padded.replace(
+        "stablehlo.reshape %1 : (tensor<8x8xf32>) -> tensor<8x8xf32>",
+        "stablehlo.reshape %1 : (tensor<8x8xf32>) -> tensor<64xf32>")
+    assert equiv.canonicalize("p", real).digest != a.digest
+
+
+def test_func_call_inlining_matches_handwritten_inline():
+    outlined = textwrap.dedent("""\
+        module @jit_f {
+          func.func public @main(%arg0: tensor<8x8xf32>, %arg1: tensor<8x8xf32>) -> (tensor<8x8xf32>) {
+            %0 = func.call @helper(%arg0, %arg1) : (tensor<8x8xf32>, tensor<8x8xf32>) -> tensor<8x8xf32>
+            %1 = stablehlo.multiply %0, %0 : tensor<8x8xf32>
+            return %1 : tensor<8x8xf32>
+          }
+          func.func private @helper(%arg0: tensor<8x8xf32>, %arg1: tensor<8x8xf32>) -> tensor<8x8xf32> {
+            %0 = stablehlo.add %arg0, %arg1 : tensor<8x8xf32>
+            return %0 : tensor<8x8xf32>
+          }
+        }
+    """)
+    inline = _module("""\
+        %0 = stablehlo.add %arg0, %arg1 : tensor<8x8xf32>
+        %1 = stablehlo.multiply %0, %0 : tensor<8x8xf32>
+        return %1 : tensor<8x8xf32>
+    """)
+    a = equiv.canonicalize("p", outlined)
+    b = equiv.canonicalize("p", inline)
+    assert a.digest == b.digest
+
+
+def test_single_op_mutation_moves_digest_and_differ_names_it():
+    mutated = _BASE.replace("stablehlo.subtract", "stablehlo.divide", 1)
+    a = equiv.canonicalize("p", _BASE)
+    b = equiv.canonicalize("p", mutated)
+    assert a.digest != b.digest
+    diff = equiv.structural_diff(a.lines, b.lines)
+    assert diff is not None
+    assert "first divergent op" in diff
+    assert "subtract" in diff and "divide" in diff
+    assert equiv.structural_diff(a.lines, list(a.lines)) is None
+
+
+def test_duplicate_subcomputations_collapse_and_are_reported():
+    dup = _module("""\
+        %0 = stablehlo.add %arg0, %arg1 : tensor<8x8xf32>
+        %1 = stablehlo.add %arg1, %arg0 : tensor<8x8xf32>
+        %2 = stablehlo.multiply %0, %1 : tensor<8x8xf32>
+        return %2 : tensor<8x8xf32>
+    """)
+    r = equiv.canonicalize("p", dup)
+    # Value numbering is Merkle-style: the re-computed (commuted) add
+    # collapses onto its first definition in the canonical form...
+    assert r.n_ops == 2
+    # ...and is reported as a CSE-duplicate group for EQ604.
+    (g,) = r.duplicates
+    assert g.op == "add" and g.count == 2
+    assert g.redundant_flops == 64.0
+    assert r.cse_duplicate_flops == 64.0
+
+
+def test_dead_output_detection():
+    dead = _module("""\
+        %0 = stablehlo.add %arg0, %arg1 : tensor<8x8xf32>
+        %1 = stablehlo.multiply %arg0, %arg0 : tensor<8x8xf32>
+        return %0 : tensor<8x8xf32>
+    """)
+    r = equiv.canonicalize("p", dead)
+    (d,) = r.dead_ops
+    assert d.op == "multiply" and d.flops == 64.0
+    assert not equiv.canonicalize("p", _BASE).dead_ops
+
+
+def test_build_semantic_report_is_tolerant():
+    r = equiv.build_semantic_report("broken", "not stablehlo at all")
+    assert not r.available and r.error
+    assert equiv.semantic_summary(r)["available"] is False
+
+
+# ---------------------------------------------------------------------------
+# The EQ rules against manifests (fire AND silent)
+# ---------------------------------------------------------------------------
+
+
+def test_eq601_fire_and_silent_names_divergent_op(tmp_path):
+    d = str(tmp_path)
+    a = equiv.canonicalize("p", _BASE)
+    write_manifest(manifest_path("p", d), manifest_from_report(a))
+    assert not _live(check_report_against_dir(a, d))      # silent
+    b = equiv.canonicalize(
+        "p", _BASE.replace("stablehlo.subtract", "stablehlo.divide", 1))
+    (f,) = _live(check_report_against_dir(b, d), "EQ601")
+    assert "fingerprint drifted" in f.message
+    assert "divide" in f.message          # the divergent op is named
+    assert "--update" in f.message
+
+
+def test_eq601_quiet_when_report_unavailable(tmp_path):
+    d = str(tmp_path)
+    a = equiv.canonicalize("p", _BASE)
+    write_manifest(manifest_path("p", d), manifest_from_report(a))
+    ghost = equiv.SemanticReport(name="p", available=False)
+    assert not _live(check_report_against_dir(ghost, d))
+
+
+def test_eq603_and_eq604_fire_and_silent():
+    r = equiv.canonicalize("p", _module("""\
+        %0 = stablehlo.add %arg0, %arg1 : tensor<8x8xf32>
+        %1 = stablehlo.add %arg1, %arg0 : tensor<8x8xf32>
+        %2 = stablehlo.multiply %arg0, %arg0 : tensor<8x8xf32>
+        %3 = stablehlo.subtract %0, %1 : tensor<8x8xf32>
+        return %3 : tensor<8x8xf32>
+    """))
+    m = manifest_from_report(r)
+    assert not _live(check_report(r, m, "m.json"))        # self-pin: silent
+    m.budgets.dead_ops = 0
+    m.budgets.duplicate_flops = 0.0
+    (f3,) = _live(check_report(r, m, "m.json"), "EQ603")
+    assert "dead computation" in f3.message and "multiply" in f3.message
+    (f4,) = _live(check_report(r, m, "m.json"), "EQ604")
+    assert "duplicate subcomputation" in f4.message
+    assert "MC404" in f4.message
+
+
+def test_suppressions_are_key_scoped_and_reason_mandatory(tmp_path):
+    d = str(tmp_path)
+    a = equiv.canonicalize("p", _BASE)
+    b = equiv.canonicalize(
+        "p", _BASE.replace("stablehlo.subtract", "stablehlo.divide", 1))
+    path = manifest_path("p", d)
+
+    write_manifest(path, manifest_from_report(
+        a, [Suppression("EQ601", "digest", "planned refactor, reviewed")]))
+    fs = check_report_against_dir(b, d)
+    assert not _live(fs) and any(f.suppressed for f in fs)
+
+    # The wrong key does not cover the digest finding.
+    write_manifest(path, manifest_from_report(
+        a, [Suppression("EQ601", "dead_ops", "reviewed")]))
+    assert _live(check_report_against_dir(b, d), "EQ601")
+
+    # Reasonless suppression: still suppresses, but EQ002 flags it.
+    write_manifest(path, manifest_from_report(
+        a, [Suppression("EQ601", "digest", None)]))
+    fs = check_report_against_dir(b, d)
+    assert not _live(fs, "EQ601")
+    (w,) = _live(fs, "EQ002")
+    assert w.severity == "warning" and "no reason" in w.message
+
+
+def test_eq605_missing_and_unreadable_manifest(tmp_path):
+    r = equiv.canonicalize("ghost", _BASE)
+    (f,) = check_report_against_dir(r, str(tmp_path))
+    assert f.rule == "EQ605" and "--update" in f.message
+    with open(manifest_path("ghost", str(tmp_path)), "w") as fh:
+        fh.write("{not json")
+    (f2,) = check_report_against_dir(r, str(tmp_path))
+    assert f2.rule == "EQ605" and "unreadable" in f2.message
+    with open(manifest_path("ghost", str(tmp_path)), "w") as fh:
+        json.dump({"version": 1, "tool": "memcheck"}, fh)
+    (f3,) = check_report_against_dir(r, str(tmp_path))
+    assert f3.rule == "EQ605"
+
+
+# ---------------------------------------------------------------------------
+# Manifest round-trip + update-preserves-suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_round_trip(tmp_path):
+    r = equiv.canonicalize("rt_prog", _BASE)
+    m = manifest_from_report(
+        r, [Suppression("EQ604", "*", "known fanout, reviewed")])
+    path = manifest_path("rt_prog", str(tmp_path))
+    write_manifest(path, m)
+    loaded = load_manifest(path)
+    assert loaded.program == "rt_prog"
+    assert loaded.budgets == EquivBudget(
+        digest=r.digest, n_ops=3, duplicate_flops=0.0, dead_ops=0)
+    assert loaded.observed["lines"] == r.lines
+    assert loaded.suppressions[0].reason == "known fanout, reviewed"
+    assert not _live(check_report_against_dir(r, str(tmp_path)))
+
+
+def test_update_preserves_suppressions(tmp_path, monkeypatch):
+    import dataclasses
+    import types
+
+    d = str(tmp_path)
+    supp = Suppression("EQ604", "duplicate_flops",
+                       "threefry splits duplicate by construction")
+    old = equiv.canonicalize("train_step", _BASE)
+    write_manifest(manifest_path("train_step", d),
+                   manifest_from_report(old, [supp]))
+    new = equiv.canonicalize(
+        "train_step",
+        _BASE.replace("stablehlo.subtract", "stablehlo.divide", 1))
+    monkeypatch.setitem(
+        sc.REGISTRY, "train_step",
+        dataclasses.replace(
+            sc.REGISTRY["train_step"],
+            build=lambda: types.SimpleNamespace(semantic=new)))
+    eqc.update_manifests(["train_step"], d)
+    loaded = load_manifest(manifest_path("train_step", d))
+    assert loaded.suppressions == [supp]
+    assert loaded.budgets.digest == new.digest
+
+
+def test_semantic_report_for_tolerates_semanticless_builder(monkeypatch):
+    import dataclasses
+    import types
+
+    monkeypatch.setitem(
+        sc.REGISTRY, "train_step",
+        dataclasses.replace(sc.REGISTRY["train_step"],
+                            build=lambda: types.SimpleNamespace()))
+    r = eqc.semantic_report_for("train_step")
+    assert r.name == "train_step" and not r.available
+
+
+# ---------------------------------------------------------------------------
+# The scan-hoist verifier: certify the good hoist, refute the broken ones
+# ---------------------------------------------------------------------------
+
+
+def _orig_recomputes(c, xs):
+    def body(carry, x):
+        w = jnp.tanh(c) - 0.1 * c          # loop-invariant conditioning
+        return carry + w * x, None
+    out, _ = jax.lax.scan(body, jnp.zeros_like(c), xs)
+    return out
+
+
+def _hoist_good(c, xs):
+    w = jnp.tanh(c) - 0.1 * c
+    def body(carry, x):
+        return carry + w * x, None
+    out, _ = jax.lax.scan(body, jnp.zeros_like(c), xs)
+    return out
+
+
+def _hoist_swapped_operands(c, xs):
+    w = 0.1 * c - jnp.tanh(c)              # non-commutative order flipped
+    def body(carry, x):
+        return carry + w * x, None
+    out, _ = jax.lax.scan(body, jnp.zeros_like(c), xs)
+    return out
+
+
+def _hoist_dropped_dependency(c, xs):
+    w = jnp.tanh(c)                        # the -0.1*c term vanished
+    def body(carry, x):
+        return carry + w * x, None
+    out, _ = jax.lax.scan(body, jnp.zeros_like(c), xs)
+    return out
+
+
+_HOIST_ARGS = (np.linspace(-1.0, 1.0, 8, dtype=np.float32),
+               np.ones((5, 8), dtype=np.float32))
+
+
+def test_verify_hoist_certifies_the_correct_hoist():
+    v = equiv.verify_hoist(_orig_recomputes, _hoist_good, _HOIST_ARGS,
+                           name="cond_hoist")
+    assert v.equivalent, "\n".join(f.render() for f in v.findings)
+    assert v.matched >= 2 and not v.unmatched
+    assert v.trials == 2 and v.max_abs_diff <= 1e-5
+
+
+def test_verify_hoist_refutes_swapped_operand_order():
+    v = equiv.verify_hoist(_orig_recomputes, _hoist_swapped_operands,
+                           _HOIST_ARGS, name="cond_hoist")
+    assert not v.equivalent
+    assert all(f.rule == "EQ602" for f in v.findings)
+    # Structural half: the flipped subtract has no in-loop ancestor.
+    assert v.unmatched
+    assert any("no ancestor" in f.message for f in v.findings)
+
+
+def test_verify_hoist_refutes_dropped_dependency():
+    v = equiv.verify_hoist(_orig_recomputes, _hoist_dropped_dependency,
+                           _HOIST_ARGS, name="cond_hoist")
+    assert not v.equivalent
+    # The surviving tanh DOES have an ancestor — only the concrete
+    # cross-check can catch a dropped term.
+    assert not v.unmatched
+    assert any(f.rule == "EQ602" and "cross-check diverged" in f.message
+               for f in v.findings)
+
+
+def test_verify_hoist_flags_unanalyzable_program():
+    class _Fake:
+        def lower(self, *a):
+            return self
+        def as_text(self):
+            return "not stablehlo"
+        def __call__(self, *a):
+            return jnp.zeros(())
+    v = equiv.verify_hoist(_Fake(), _Fake(), (np.float32(0.0),))
+    assert not v.equivalent
+    assert any("unverifiable" in f.message for f in v.findings)
+
+
+def test_randomized_args_keep_integer_schedule_values():
+    rng = np.random.default_rng(0)
+    f, i = equiv._randomized_args(
+        (np.ones(4, np.float32), np.arange(3, dtype=np.int32)), rng)
+    assert not np.array_equal(f, np.ones(4, np.float32))
+    np.testing.assert_array_equal(i, np.arange(3, dtype=np.int32))
+
+
+# ---------------------------------------------------------------------------
+# The semantic_pin marker
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.semantic_pin
+def test_semantic_pin_marker_e2e(equiv_check, tmp_path):
+    equiv_check.manifest_dir = str(tmp_path)
+    r = equiv_check.analyze(
+        "marker_prog",
+        jax.jit(lambda x, y: jnp.tanh(x) * y).lower(_sds((4, 4)),
+                                                    _sds((4, 4))))
+    assert r.available and r.digest      # the pin is non-vacuous
+    write_manifest(manifest_path("marker_prog", str(tmp_path)),
+                   manifest_from_report(r))
+
+
+def test_equiv_check_accepts_text_and_reports_findings(tmp_path):
+    check = EquivCheck()
+    check.manifest_dir = str(tmp_path)
+    r = check.analyze("txt_prog", _BASE)
+    assert r.digest
+    (f,) = check.findings()
+    assert f.rule == "EQ605"             # nothing committed yet
+    write_manifest(manifest_path("txt_prog", str(tmp_path)),
+                   manifest_from_report(r))
+    assert not check.findings()
+
+
+def test_semantic_pin_vacuous_pass_protection(pytester):
+    pytester.makepyfile(textwrap.dedent("""\
+        import pytest
+
+        @pytest.mark.semantic_pin
+        def test_never_registers(equiv_check):
+            pass
+    """))
+    result = pytester.runpytest_inprocess(
+        "-p", "diff3d_tpu.analysis.pytest_plugin",
+        "-p", "no:cacheprovider", "-p", "no:randomly")
+    assert result.ret != 0
+    result.stdout.fnmatch_lines(["*vacuously*"])
+
+
+def test_semantic_pin_marker_rejects_bad_usage(pytester):
+    pytester.makepyfile(textwrap.dedent("""\
+        import pytest
+
+        @pytest.mark.semantic_pin("step_many")
+        def test_takes_no_args(equiv_check):
+            pass
+
+        @pytest.mark.semantic_pin
+        def test_no_fixture():
+            pass
+    """))
+    result = pytester.runpytest_inprocess(
+        "-p", "diff3d_tpu.analysis.pytest_plugin",
+        "-p", "no:cacheprovider", "-p", "no:randomly")
+    assert result.ret != 0
+    result.stdout.fnmatch_lines(["*takes no*"])
+    result.stdout.fnmatch_lines(["*requires the equiv_check fixture*"])
+
+
+# ---------------------------------------------------------------------------
+# CLI + tools/lint.py six-gate plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_and_bad_invocation(capsys):
+    assert eqc.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for nm in sc.REGISTRY:
+        assert nm in out
+    assert eqc.main(["--program", "train_step", "--programs-tier1"]) == 2
+
+
+def _load_lint_script():
+    path = os.path.join(_REPO_ROOT, "tools", "lint.py")
+    spec = importlib.util.spec_from_file_location("_lint_gate_check", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lint_runs_six_gates_equivcheck_last():
+    lint_script = _load_lint_script()
+    names = [name for name, _, _ in lint_script._GATES]
+    assert names == ["graftlint", "lockcheck", "shardcheck", "memcheck",
+                     "rngcheck", "equivcheck"]
+    assert lint_script._ONLY_TO_GATE["--equiv-only"] == "equivcheck"
+    assert set(lint_script._ONLY_FLAGS) == set(lint_script._ONLY_TO_GATE)
+
+
+def test_lint_equiv_only_passes_arguments_through(monkeypatch):
+    lint_script = _load_lint_script()
+    calls = []
+
+    def fake_gate_main(module):
+        def run(argv):
+            calls.append((module, list(argv)))
+            return 0
+        return run
+
+    monkeypatch.setattr(lint_script, "_gate_main", fake_gate_main)
+    monkeypatch.setattr(sys, "argv", ["lint.py", "--equiv-only", "--list"])
+    assert lint_script.main() == 0
+    assert calls == [("diff3d_tpu.analysis.equivcheck", ["--list"])]
+
+
+def test_lint_json_summary_aggregates_all_gates(monkeypatch, capsys):
+    lint_script = _load_lint_script()
+    rcs = {"memcheck": 1}
+
+    def fake_gate_main(module):
+        name = module.rsplit(".", 1)[-1]
+        name = {"lint": "graftlint"}.get(name, name)
+
+        def run(argv):
+            assert argv[-2:] == ["--format", "json"]
+            print(json.dumps({"unsuppressed": rcs.get(name, 0),
+                              "suppressed": 2}))
+            return rcs.get(name, 0)
+        return run
+
+    monkeypatch.setattr(lint_script, "_gate_main", fake_gate_main)
+    monkeypatch.setattr(sys, "argv", ["lint.py", "--json"])
+    assert lint_script.main() == 1       # exit = max over gates
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc["gates"]) == {"graftlint", "lockcheck", "shardcheck",
+                                 "memcheck", "rngcheck", "equivcheck"}
+    assert doc["exit"] == 1
+    assert doc["gates"]["memcheck"]["unsuppressed"] == 1
+    assert doc["gates"]["equivcheck"] == {
+        "exit": 0, "unsuppressed": 0, "suppressed": 2}
+
+
+def test_lint_json_is_exclusive_with_only_flags(monkeypatch, capsys):
+    lint_script = _load_lint_script()
+    monkeypatch.setattr(sys, "argv",
+                        ["lint.py", "--json", "--equiv-only"])
+    assert lint_script.main() == 2
+    monkeypatch.setattr(sys, "argv", ["lint.py", "--json", "--list"])
+    assert lint_script.main() == 2
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 gate: committed manifests match what the tree lowers
+# ---------------------------------------------------------------------------
+
+
+def test_repo_manifests_clean_tier1():
+    """The equivcheck analogue of ``test_repo_lints_clean``: lowering
+    the REAL tier-1 programs and diffing their semantic fingerprints
+    against the committed ``runs/equivcheck/`` manifests must come back
+    clean.  (The builds come from shardcheck's in-process report cache,
+    so this shares one lower+compile with the other pillars' gates.)"""
+    d = eqc.default_manifest_dir(_REPO_ROOT)
+    findings = eqc.check_programs(list(sc.TIER1_PROGRAMS), d)
+    live = _live(findings)
+    assert not live, "\n".join(f.render() for f in live)
+
+
+def test_repo_manifest_pins_exact_tier1():
+    """observed == recomputed, not merely within budget: a fingerprint
+    that silently moves together with a hand-edited manifest would
+    leave the gate green — exact equality makes every drift a visible
+    diff that either re-pins via ``equivcheck --update`` or reverts."""
+    d = eqc.default_manifest_dir(_REPO_ROOT)
+    for nm in sc.TIER1_PROGRAMS:
+        committed = load_manifest(manifest_path(nm, d))
+        sem = eqc.semantic_report_for(nm)
+        assert committed.budgets.digest == sem.digest, (
+            f"{nm}: committed fingerprint is stale — run "
+            f"'python tools/equivcheck.py --update' and review the diff")
+        assert committed.observed.get("lines") == sem.lines
+
+
+def test_seeded_mutation_of_live_step_many_fires_eq601():
+    """The acceptance regression: a single-op mutation of the REAL
+    step_many StableHLO must flip the fingerprint and EQ601 must name
+    the divergent op against the committed manifest."""
+    sampler, _env = sc._sampler()
+    txt = sampler.lower_step_many(lanes=sc.MESH_DEVICES,
+                                  capacity=4).as_text()
+    d = eqc.default_manifest_dir(_REPO_ROOT)
+    committed = load_manifest(manifest_path("step_many", d))
+    base = equiv.canonicalize("step_many", txt)
+    assert base.digest == committed.budgets.digest   # identity guard
+    assert "stablehlo.subtract" in txt
+    mutated = equiv.canonicalize(
+        "step_many",
+        txt.replace("stablehlo.subtract", "stablehlo.divide", 1))
+    hits = _live(check_report_against_dir(mutated, d), "EQ601")
+    assert hits, "mutated step_many did not trip EQ601"
+    assert "first divergent op" in hits[0].message
+    assert "divide" in hits[0].message
+
+
+def test_eq604_agrees_with_memchecks_mc404_pin_tier1():
+    """Cross-pillar agreement (the issue's satellite 4): equivcheck's
+    static loop-invariant estimate for step_many must agree with the
+    committed memcheck MC404 pin — two independent walks over the same
+    lowering.  (Both now report ~154 kFLOP/step; the historical
+    ~1.8 GFLOP figure was a shared parser artifact, fixed by parsing
+    generic-syntax anonymous regions.)"""
+    sem = eqc.semantic_report_for("step_many")
+    md = mc.default_manifest_dir(_REPO_ROOT)
+    pin = mb.load_manifest(
+        mb.manifest_path("step_many", md)).budgets.hoistable_flops_per_step
+    assert pin > 0 and sem.hoistable_flops_per_step > 0
+    assert sem.hoistable_flops_per_step == pytest.approx(pin, rel=0.25)
+    # The static duplicate ceiling subsumes the per-iteration recompute.
+    assert sem.duplicate_flops >= sem.hoistable_flops_per_step
+
+
+def test_manifests_are_committed_for_all_registered_programs():
+    d = eqc.default_manifest_dir(_REPO_ROOT)
+    for nm in sc.REGISTRY:
+        assert os.path.exists(manifest_path(nm, d)), (
+            f"missing committed equivcheck manifest for {nm}; run "
+            f"'python tools/equivcheck.py --update --program {nm}'")
+
+
+@pytest.mark.slow
+def test_repo_manifests_clean_full_sweep():
+    """All five registered programs (adds distill, DDIM, serving
+    warmup) — the full manifest sweep the CLI runs."""
+    d = eqc.default_manifest_dir(_REPO_ROOT)
+    findings = eqc.check_programs(sorted(sc.REGISTRY), d)
+    live = _live(findings)
+    assert not live, "\n".join(f.render() for f in live)

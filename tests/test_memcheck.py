@@ -637,18 +637,24 @@ def test_repo_manifest_pins_exact_tier1():
 def test_tier1_step_many_pins_nonzero_hoistable_conditioning():
     """ROADMAP item 2a as a pinned number: the committed step_many
     manifest must carry a NONZERO hoistable-FLOPs ceiling — the sampler
-    recomputes its conditioning branch every denoise step today, and
-    the manifest is the machine-checked record.  When conditioning
-    reuse lands, this ceiling is tightened, not deleted."""
+    still recomputes loop-invariant conditioning work every denoise
+    step, and the manifest is the machine-checked record.  When
+    conditioning reuse lands, this ceiling is tightened, not deleted.
+
+    (The earlier ~1.8 GFLOP/step figure was a parser artifact: the
+    quoted generic-syntax ops in the denoiser callee truncated the
+    callee parse, making the whole denoiser look like an invariant
+    passthrough.  With anonymous regions parsed correctly the true
+    invariant portion is ~154 kFLOP/step — equivcheck pins the same
+    number independently, see test_equivcheck's cross-pillar gate.)"""
     d = mc.default_manifest_dir(_REPO_ROOT)
     m = load_manifest(manifest_path("step_many", d))
     assert m.budgets.hoistable_flops_per_step > 0
     obs = m.observed
     assert obs["hoistable_flops_per_step"] > 0
-    # The conditioning recompute dominates: a large share of per-step
-    # FLOPs is loop-invariant.
     (loop,) = [l for l in obs["scan_loops"]]
-    assert loop["invariant_flops"] > 0.25 * loop["total_flops"]
+    assert loop["invariant_flops"] > 0
+    assert loop["invariant_flops"] <= loop["total_flops"]
     # The record_imgs donation must stay effective — pinned by index.
     assert m.budgets.effective_donations
 

@@ -1,0 +1,26 @@
+"""StableHLO semantic-equivalence gate, runnable as a plain script:
+``python tools/equivcheck.py [--program NAME | --update | --list]``.
+
+Thin wrapper over ``diff3d_tpu.analysis.equivcheck`` (also installed as
+the ``equivcheck`` console script) so the gate works from a checkout
+without installing the package.  All arguments pass through — see
+``--help`` for the program registry and manifest workflow, and
+docs/DESIGN.md §18 for policy.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from diff3d_tpu.analysis.equivcheck import main as equivcheck_main
+    return equivcheck_main(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

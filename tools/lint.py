@@ -1,7 +1,7 @@
 """Repo static-analysis gate, runnable as a plain script:
 ``python tools/lint.py``.
 
-Runs ALL FIVE passes as one gate (nonzero exit if any finds anything
+Runs ALL SIX passes as one gate (nonzero exit if any finds anything
 unsuppressed):
 
   * **graftlint** — the AST pass (rules GL1xx, docs/DESIGN.md §9);
@@ -22,23 +22,86 @@ unsuppressed):
     §17): interprocedural linear-key dataflow + seed hygiene +
     precision flow over the default targets, and the tier-1 stream
     manifests (ordered key-derivation digests) under
-    ``runs/rngcheck/``.
+    ``runs/rngcheck/``;
+  * **equivcheck** — the semantic-equivalence pass over the same
+    tier-1 program set (rules EQ6xx, docs/DESIGN.md §18): canonical
+    StableHLO fingerprints, dead-output and duplicate-subcomputation
+    ceilings against the manifests under ``runs/equivcheck/``.
 
 ``--ast-only`` / ``--ir-only`` / ``--lock-only`` / ``--mem-only`` /
-``--rng-only``
+``--rng-only`` / ``--equiv-only``
 select one pass; all other arguments pass through to the selected pass
 — with multiple passes active only argument-free invocation is
-supported (pass-specific flags differ).  Works from a checkout without
+supported (pass-specific flags differ).  ``--json`` (no pass selected)
+runs every gate with its JSON formatter and emits one machine-readable
+summary — per-pillar unsuppressed/suppressed counts and exit status —
+without changing the exit semantics.  Works from a checkout without
 installing the package.
 """
 
 from __future__ import annotations
 
+import contextlib
+import io
+import json
 import os
 import sys
 
 _ONLY_FLAGS = ("--ast-only", "--ir-only", "--lock-only", "--mem-only",
-               "--rng-only")
+               "--rng-only", "--equiv-only")
+
+#: gate name -> (module path, main-attr defaults when running the full
+#: gate).  Order is the gate order: cheap AST/source passes first, the
+#: lower+compile passes after (they share one report cache).
+_GATES = (
+    ("graftlint", "diff3d_tpu.analysis.lint", []),
+    ("lockcheck", "diff3d_tpu.analysis.lockcheck", []),
+    ("shardcheck", "diff3d_tpu.analysis.shardcheck",
+     ["--programs-tier1"]),
+    ("memcheck", "diff3d_tpu.analysis.memcheck", ["--programs-tier1"]),
+    ("rngcheck", "diff3d_tpu.analysis.rngcheck", ["--streams-tier1"]),
+    ("equivcheck", "diff3d_tpu.analysis.equivcheck",
+     ["--programs-tier1"]),
+)
+
+_ONLY_TO_GATE = {
+    "--ast-only": "graftlint",
+    "--lock-only": "lockcheck",
+    "--ir-only": "shardcheck",
+    "--mem-only": "memcheck",
+    "--rng-only": "rngcheck",
+    "--equiv-only": "equivcheck",
+}
+
+
+def _gate_main(module: str):
+    import importlib
+
+    return importlib.import_module(module).main
+
+
+def _run_json_summary() -> int:
+    """Run every gate under its JSON formatter, fold the per-pillar
+    counts into one summary document.  Exit semantics match the plain
+    run: max of the per-gate exit codes."""
+    summary = {"gates": {}, "exit": 0}
+    for name, module, defaults in _GATES:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = _gate_main(module)(defaults + ["--format", "json"])
+        entry = {"exit": code, "unsuppressed": None, "suppressed": None}
+        try:
+            doc = json.loads(buf.getvalue())
+            entry["unsuppressed"] = doc.get("unsuppressed")
+            entry["suppressed"] = doc.get("suppressed")
+        except ValueError:
+            # A gate that crashed before printing JSON still reports
+            # its exit code; counts stay null rather than fabricated.
+            pass
+        summary["gates"][name] = entry
+        summary["exit"] = max(summary["exit"], code)
+    print(json.dumps(summary, indent=1))
+    return summary["exit"]
 
 
 def main() -> int:
@@ -53,6 +116,17 @@ def main() -> int:
               file=sys.stderr)
         return 2
     selected = only[0] if only else None
+    if "--json" in argv:
+        if selected is not None:
+            print("tools/lint.py: --json runs every gate; use "
+                  f"'{selected} ... --format json' for one pass",
+                  file=sys.stderr)
+            return 2
+        if [a for a in argv if a != "--json"]:
+            print("tools/lint.py: --json takes no other arguments",
+                  file=sys.stderr)
+            return 2
+        return _run_json_summary()
     if argv and selected is None:
         print("tools/lint.py: pass-through arguments need one of "
               f"{', '.join(_ONLY_FLAGS)} (the passes take different "
@@ -60,24 +134,11 @@ def main() -> int:
         return 2
 
     rc = 0
-    if selected in (None, "--ast-only"):
-        from diff3d_tpu.analysis.lint import main as lint_main
-        rc = max(rc, lint_main(argv if selected else []))
-    if selected in (None, "--lock-only"):
-        from diff3d_tpu.analysis.lockcheck import main as lockcheck_main
-        rc = max(rc, lockcheck_main(argv if selected else []))
-    if selected in (None, "--ir-only"):
-        from diff3d_tpu.analysis.shardcheck import main as shardcheck_main
-        rc = max(rc, shardcheck_main(
-            argv if selected else ["--programs-tier1"]))
-    if selected in (None, "--mem-only"):
-        from diff3d_tpu.analysis.memcheck import main as memcheck_main
-        rc = max(rc, memcheck_main(
-            argv if selected else ["--programs-tier1"]))
-    if selected in (None, "--rng-only"):
-        from diff3d_tpu.analysis.rngcheck import main as rngcheck_main
-        rc = max(rc, rngcheck_main(
-            argv if selected else ["--streams-tier1"]))
+    wanted = _ONLY_TO_GATE.get(selected)
+    for name, module, defaults in _GATES:
+        if selected is not None and name != wanted:
+            continue
+        rc = max(rc, _gate_main(module)(argv if selected else defaults))
     return rc
 
 
