@@ -96,6 +96,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(payload 'session_id') pin to a replica; "
                         "adds GET /fleet and router counters to "
                         "GET /metrics")
+    p.add_argument("--workers", default=None,
+                   help="front pre-started worker processes "
+                        "(diff3d_tpu.cli.worker_cli) as remote replicas: "
+                        "'host:port,host:port'.  Mixes with --replicas: "
+                        "N in-process replicas plus the listed workers "
+                        "form one fleet (sessions pin across both kinds"
+                        "); with --workers alone no local engine is "
+                        "built, so this process needs no devices")
     p.add_argument("--scan_chunks", type=int, default=1,
                    help="split each view's diffusion scan into this many "
                         "device executions (must divide the per-view "
@@ -126,6 +134,7 @@ def build_service(args):
     from diff3d_tpu.models import XUNet
     from diff3d_tpu.sampling import Sampler, record_capacity
     from diff3d_tpu.serving import FleetService, ServingService
+    from diff3d_tpu.serving.fleet import build_fleet
 
     cfg = {"srn64": config_lib.srn64_config,
            "srn128": config_lib.srn128_config,
@@ -136,8 +145,10 @@ def build_service(args):
                                                timesteps=args.steps))
     cfg = apply_model_width_overrides(cfg, args)
     over = {k: getattr(args, k) for k in
-            ("host", "port", "max_batch", "max_queue", "replicas")
+            ("host", "port", "max_batch", "max_queue")
             if getattr(args, k) is not None}
+    if args.replicas:            # 0 = remote-only fleet, keep cfg valid
+        over["replicas"] = args.replicas
     if args.max_wait_ms is not None:
         over["max_wait_ms"] = args.max_wait_ms
     if args.timeout_s is not None:
@@ -148,6 +159,50 @@ def build_service(args):
         cfg = dataclasses.replace(
             cfg, serving=dataclasses.replace(cfg.serving, **over))
     cfg.validate()
+
+    worker_addrs = []
+    if getattr(args, "workers", None):
+        for spec in args.workers.split(","):
+            spec = spec.strip()
+            if not spec:
+                continue
+            host, _, port_s = spec.rpartition(":")
+            try:
+                worker_addrs.append((host or "127.0.0.1", int(port_s)))
+            except ValueError:
+                raise SystemExit(
+                    f"--workers entry {spec!r}: expected 'host:port'")
+    # Local in-process replicas: with --workers present, default to a
+    # pure-remote fleet unless --replicas asks for locals too.
+    n_local = args.replicas if args.replicas is not None else (
+        0 if worker_addrs else cfg.serving.replicas)
+    if n_local == 0 and not worker_addrs:
+        raise SystemExit("--replicas 0 needs --workers")
+
+    def _remotes():
+        from diff3d_tpu.serving.transport import (RemoteReplica,
+                                                  TransportError)
+
+        reps = []
+        for host, port in worker_addrs:
+            try:
+                reps.append(RemoteReplica(
+                    host, port,
+                    heartbeat_interval_s=cfg.serving.heartbeat_interval_s,
+                    heartbeat_timeout_s=cfg.serving.heartbeat_timeout_s,
+                    max_frame_bytes=cfg.serving.max_frame_bytes))
+            except TransportError as e:
+                raise SystemExit(
+                    f"--workers {host}:{port}: worker unreachable "
+                    f"({e}) — start it first with "
+                    f"'python -m diff3d_tpu.cli.worker_cli'")
+        return reps
+
+    if n_local == 0:
+        # Remote-only front door: no local engine, no devices touched.
+        logging.info("fronting %d remote workers, no local replicas",
+                     len(worker_addrs))
+        return FleetService(_remotes(), cfg)
 
     model = XUNet(cfg.model)
     if args.init == "random":
@@ -177,7 +232,7 @@ def build_service(args):
     sampler = Sampler(model, params, cfg, scan_chunks=args.scan_chunks,
                       mesh=mesh_env, sampler_kind=args.sampler,
                       steps=args.sampler_steps)
-    n_replicas = cfg.serving.replicas
+    n_replicas = n_local
     extra_samplers = {}
     per_replica_extra = {}
     made = {}                  # one Sampler per distinct extra schedule
@@ -221,9 +276,19 @@ def build_service(args):
             else:
                 per_replica_extra.setdefault(idx, {})[sched] = (
                     _sampler_for(sched))
-    if n_replicas > 1:
+    if worker_addrs:
+        # Mixed fleet: local in-process replicas + remote workers
+        # behind one router (sessions pin across both kinds).
+        local = build_fleet(
+            sampler, cfg, n_replicas,
+            extra_samplers=extra_samplers or None,
+            per_replica_extra=per_replica_extra or None,
+            params_version=version)
+        service = FleetService(local + _remotes(), cfg)
+    elif n_replicas > 1:
         service = FleetService.build(
-            sampler, cfg, extra_samplers=extra_samplers or None,
+            sampler, cfg, n=n_replicas,
+            extra_samplers=extra_samplers or None,
             per_replica_extra=per_replica_extra or None,
             params_version=version)
     else:
@@ -237,8 +302,11 @@ def build_service(args):
         from diff3d_tpu.serving import Bucket
 
         cap = record_capacity(cfg.serving.max_views)
-        engines = ([service.engine] if n_replicas == 1
-                   else [rep.engine for rep in service.replicas])
+        # Remote replicas warm their own programs at worker boot; only
+        # local engines can be warmed from this process.
+        engines = ([service.engine] if hasattr(service, "engine")
+                   else [rep.engine for rep in service.replicas
+                         if hasattr(rep, "engine")])
         for eng in engines:
             for s in eng.samplers.values():
                 bucket = Bucket(cfg.model.H, cfg.model.W, cap,

@@ -257,6 +257,15 @@ class ServingConfig:
     # (1 = single-replica ServingService, no router).  Each replica owns
     # its own scheduler/engine/program cache; sessions pin to replicas.
     replicas: int = 1
+    # ---- cross-process fleet (serving/transport.py, DESIGN.md §19) ---
+    # RemoteReplica connection supervision: probe the worker every
+    # `interval`; a worker silent past `timeout` is marked dead (its
+    # sticky sessions get SessionLost, exactly like an in-process kill).
+    heartbeat_interval_s: float = 0.25
+    heartbeat_timeout_s: float = 3.0
+    # Transport frame-size ceiling (a garbage length prefix must not
+    # demand gigabytes of buffer).
+    max_frame_bytes: int = 1 << 30
 
     def validate(self) -> None:
         if self.max_batch < 1:
@@ -297,6 +306,19 @@ class ServingConfig:
                 ">= 0")
         if self.replicas < 1:
             raise ValueError(f"replicas={self.replicas} must be >= 1")
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError(
+                f"heartbeat_interval_s={self.heartbeat_interval_s} must "
+                "be > 0")
+        if self.heartbeat_timeout_s <= self.heartbeat_interval_s:
+            raise ValueError(
+                f"heartbeat_timeout_s={self.heartbeat_timeout_s} must "
+                f"exceed heartbeat_interval_s={self.heartbeat_interval_s} "
+                "(a single missed probe must not kill a replica)")
+        if self.max_frame_bytes < (1 << 16):
+            raise ValueError(
+                f"max_frame_bytes={self.max_frame_bytes} must be >= 64 KiB "
+                "(a single 8x8 view frame already needs ~1 KiB of JSON)")
 
 
 @dataclasses.dataclass(frozen=True)

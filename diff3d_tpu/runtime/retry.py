@@ -79,6 +79,10 @@ def is_transient_backend_error(exc: BaseException) -> bool:
         return False  # a hang, not a blip: fail fast
     if isinstance(exc, RetryableError):
         return True
+    if isinstance(exc, ConnectionError):
+        # Reset/refused/aborted against a worker socket: the transport
+        # layer retries or the heartbeat declares the peer dead.
+        return True
     msg = str(exc).lower()
     return any(marker in msg for marker in _TRANSIENT_MARKERS)
 

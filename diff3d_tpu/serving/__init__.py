@@ -11,7 +11,12 @@ and metrics endpoints.  Above the single engine, the fleet router
 (``serving/router.py`` + ``serving/fleet.py``) runs N replicas behind one
 front door with session affinity (device-resident records never migrate),
 typed fleet backpressure, blue/green params rollout and schedule-aware
-placement.
+placement.  The cross-process fleet (``serving/transport.py`` +
+``serving/worker.py``) puts the same replica surface behind a socket:
+workers pin replicas to disjoint device slices, the router fronts them
+through :class:`RemoteReplica` with zero placement changes, and
+HBM-budgeted admission rejects at the door with a typed
+:class:`ReplicaOverBudget`.
 """
 
 from diff3d_tpu.serving.cache import (ParamsRegistry, ProgramCache,
@@ -26,6 +31,7 @@ from diff3d_tpu.serving.scheduler import (Bucket, EngineDraining,
                                           EngineOverloaded, EngineStepError,
                                           EngineStopped, FleetOverloaded,
                                           QueueFullError, ReplicaDraining,
+                                          ReplicaOverBudget,
                                           RequestCancelled, RequestTimeout,
                                           Scheduler, SessionLost,
                                           TrajectoryRequest,
@@ -33,15 +39,24 @@ from diff3d_tpu.serving.scheduler import (Bucket, EngineDraining,
 from diff3d_tpu.serving.server import (ServingService, build_request,
                                        build_trajectory_request,
                                        make_http_server)
+from diff3d_tpu.serving.transport import (FrameGarbage, FrameTooLarge,
+                                          FrameTruncated, RemoteReplica,
+                                          TransportError)
+from diff3d_tpu.serving.worker import (HbmAdmission, Worker, boot_worker,
+                                       configure_compile_cache)
 
 __all__ = [
     "Bucket", "Engine", "EngineDraining", "EngineOverloaded",
     "EngineStepError", "EngineStopTimeout", "EngineStopped",
-    "FleetOverloaded", "FleetService", "HEALTH_DEAD", "HEALTH_DEGRADED",
-    "HEALTH_DRAINING", "HEALTH_OK", "MetricsRegistry", "ParamsRegistry",
-    "ProgramCache", "QueueFullError", "Replica", "ReplicaDraining",
-    "RequestCancelled", "RequestTimeout", "ResultCache", "Router",
-    "Scheduler", "ServingService", "SessionLost", "TrajectoryRequest",
-    "UnsupportedSchedule", "ViewRequest", "build_fleet", "build_request",
-    "build_trajectory_request", "make_http_server",
+    "FleetOverloaded", "FleetService", "FrameGarbage", "FrameTooLarge",
+    "FrameTruncated", "HEALTH_DEAD", "HEALTH_DEGRADED",
+    "HEALTH_DRAINING", "HEALTH_OK", "HbmAdmission", "MetricsRegistry",
+    "ParamsRegistry", "ProgramCache", "QueueFullError", "RemoteReplica",
+    "Replica", "ReplicaDraining", "ReplicaOverBudget", "RequestCancelled",
+    "RequestTimeout", "ResultCache", "Router", "Scheduler",
+    "ServingService", "SessionLost", "TransportError",
+    "TrajectoryRequest", "UnsupportedSchedule", "ViewRequest",
+    "Worker", "boot_worker", "build_fleet", "build_request",
+    "build_trajectory_request", "configure_compile_cache",
+    "make_http_server",
 ]

@@ -59,8 +59,9 @@ from diff3d_tpu.serving.engine import (HEALTH_DEGRADED, HEALTH_DRAINING,
 from diff3d_tpu.serving.metrics import MetricsRegistry
 from diff3d_tpu.serving.scheduler import (EngineDraining, EngineOverloaded,
                                           FleetOverloaded, QueueFullError,
-                                          ReplicaDraining, SessionLost,
-                                          UnsupportedSchedule, ViewRequest)
+                                          ReplicaDraining, ReplicaOverBudget,
+                                          SessionLost, UnsupportedSchedule,
+                                          ViewRequest)
 from diff3d_tpu.serving.server import (build_request,
                                        build_trajectory_request,
                                        make_http_server, remember_request,
@@ -128,6 +129,23 @@ class Router:
             "router_rollouts_total", "blue/green rollouts started")
         self._sessions_g = m.gauge(
             "router_sessions_active", "sessions in the affinity table")
+        # Cross-process fleet supervision (serving/transport.py): these
+        # exist (at 0) even on an all-in-process fleet, so dashboards
+        # can alert on them before the first remote replica joins.
+        self._remote_connected_g = m.gauge(
+            "fleet_remote_connected",
+            "remote replicas with a live transport connection")
+        self._hb_timeouts_ctr = m.counter(
+            "fleet_heartbeat_timeouts_total",
+            "remote replicas marked dead by heartbeat timeout")
+        self._admission_rejects_ctr = m.counter(
+            'fleet_admission_rejects_total{reason="hbm"}',
+            "requests rejected by worker HBM-budgeted admission")
+        # Per-replica last-seen counter values for delta folding (worker
+        # counters are cumulative; ours must only ever inc).
+        self._remote_seen_lock = threading.Lock()
+        self._remote_seen: Dict[str, Dict[str, int]] = (
+            {})  # guarded-by: self._remote_seen_lock
 
     # -- fleet membership -------------------------------------------------
 
@@ -232,6 +250,13 @@ class Router:
                 f"{req.id}: session {sid}: owning replica {owner} "
                 "started draining; retry the same session",
                 replica=owner, retry_after_s=self.retry_after_s)) from e
+        except ReplicaOverBudget:
+            # The owner's HBM admission gate fired.  Sticky requests
+            # cannot fail over (the record is here), but unlike a dead
+            # owner the record is intact — the typed rejection carries
+            # the budget arithmetic and a Retry-After.
+            self._rejected_ctr.inc()
+            raise
         except UnsupportedSchedule:
             self._rejected_ctr.inc()
             raise
@@ -266,8 +291,10 @@ class Router:
         for i, rep in enumerate(order):
             try:
                 got = rep.submit(req)
-            except (QueueFullError, EngineOverloaded,
-                    EngineDraining) as e:
+            except (QueueFullError, EngineOverloaded, EngineDraining,
+                    ReplicaOverBudget) as e:
+                # ReplicaOverBudget: this replica's slice is out of HBM
+                # headroom, but another may admit — keep failing over.
                 last = e
                 continue
             if i > 0 or dead:
@@ -295,6 +322,19 @@ class Router:
             return self._submit_sticky(req, sid, owner)
         try:
             got = chosen.submit(req)
+        except ReplicaOverBudget:
+            # No record exists yet; release the claim exactly like the
+            # capacity path, but re-raise the typed budget rejection
+            # itself — the client (or an upstream balancer) should see
+            # the HBM arithmetic, not a generic FleetOverloaded.
+            with self._lock:
+                release = (self._sessions.get(sid) == chosen.name
+                           and chosen.session_count(sid) == 0)
+                if release:
+                    del self._sessions[sid]
+                    self._sessions_g.set(len(self._sessions))
+            self._rejected_ctr.inc()
+            raise
         except (QueueFullError, EngineOverloaded, EngineDraining) as e:
             # No record exists yet; release the claim (unless a racing
             # request already landed one) and report capacity — a new
@@ -389,12 +429,41 @@ class Router:
 
     def refresh_gauges(self) -> None:
         """Update the per-replica depth gauges (lazy get-or-create, so
-        churned-in replicas appear on their first refresh)."""
+        churned-in replicas appear on their first refresh), and fold
+        remote replicas' transport counters into the fleet metrics."""
+        connected = 0
+        deltas: List[tuple] = []
         for rep in self.replica_list():
             self.metrics.gauge(
                 f"router_replica_depth_{_metric_suffix(rep.name)}",
                 "queued + in-flight requests on this replica").set(
                     rep.depth())
+            stats_fn = getattr(rep, "transport_stats", None)
+            if stats_fn is None:
+                continue        # in-process replica: no transport
+            stats = stats_fn()
+            if stats.get("connected"):
+                connected += 1
+            deltas.append((rep.name, stats))
+        # Delta-fold cumulative worker counters into our inc-only
+        # counters: compute deltas under the last-seen lock, inc after
+        # release (Counter has its own lock; never nest them).
+        pending: List[tuple] = []
+        with self._remote_seen_lock:
+            for name, stats in deltas:
+                seen = self._remote_seen.setdefault(name, {})
+                for key, ctr in (
+                        ("heartbeat_timeouts", self._hb_timeouts_ctr),
+                        ("admission_rejects_hbm",
+                         self._admission_rejects_ctr)):
+                    now = int(stats.get(key) or 0)
+                    delta = now - seen.get(key, 0)
+                    if delta > 0:
+                        pending.append((ctr, delta))
+                    seen[key] = max(now, seen.get(key, 0))
+        for ctr, delta in pending:
+            ctr.inc(delta)
+        self._remote_connected_g.set(connected)
 
     def fleet_snapshot(self) -> dict:
         self.refresh_gauges()

@@ -140,6 +140,32 @@ class SessionLost(RetryableError):
         self.replica = replica
 
 
+class ReplicaOverBudget(RetryableError):
+    """HBM-budgeted admission control (serving/worker.py): admitting
+    this request would push the replica's device slice past its HBM
+    budget — resident session-record bytes plus the compiled program's
+    peak (the ``runs/memcheck/`` manifest pin) exceed
+    ``hbm_budget_bytes``.  Rejected *at the door*, before any device
+    work; purely a capacity signal, so retry after ``retry_after_s``
+    (or place the request on a replica with headroom)."""
+
+    def __init__(self, msg: str, *, replica: Optional[str] = None,
+                 retry_after_s: Optional[float] = None,
+                 budget_bytes: int = 0, resident_bytes: int = 0,
+                 program_peak_bytes: int = 0):
+        super().__init__(msg, retry_after_s=retry_after_s)
+        self.replica = replica
+        self.budget_bytes = int(budget_bytes)
+        self.resident_bytes = int(resident_bytes)
+        self.program_peak_bytes = int(program_peak_bytes)
+
+    @property
+    def headroom_bytes(self) -> int:
+        """Bytes left under the budget before this request's footprint
+        (negative means resident state alone is already over)."""
+        return self.budget_bytes - self.resident_bytes
+
+
 _req_ids = itertools.count()
 
 

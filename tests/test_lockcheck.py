@@ -41,6 +41,8 @@ _THREADED_MODULES = (
     "diff3d_tpu/serving/fleet.py",
     "diff3d_tpu/serving/router.py",
     "diff3d_tpu/serving/server.py",
+    "diff3d_tpu/serving/transport.py",
+    "diff3d_tpu/serving/worker.py",
     "diff3d_tpu/train/checkpoint.py",
     "diff3d_tpu/train/trainer.py",
     "diff3d_tpu/data/loader.py",

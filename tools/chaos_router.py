@@ -27,9 +27,21 @@ Exit status is 0 iff ``failed_other == hung == lost == migrations == 0``
 and every surviving replica is healthy — the fleet contract of
 DESIGN.md §14.
 
+``--remote`` runs the same soak against a *cross-process* fleet
+(DESIGN.md §19): each replica is a real ``worker_cli`` subprocess
+pinned to a disjoint CPU device slice, fronted over the socket
+transport, and the kill is a real ``SIGKILL`` of the victim's process
+— the in-process kill sites only simulate death; this one delivers it.
+The contract checked is identical: typed ``SessionLost`` naming the
+victim, sessionless failover to the survivors, zero hung requests,
+zero migrations (the dead worker's ledger survives in the router's
+last-heartbeat cache, so the audit still sees its sessions).
+
 Usage (CPU):
     JAX_PLATFORMS=cpu python tools/chaos_router.py \
         --replicas 3 --sessions 6 --views 3 --json
+    JAX_PLATFORMS=cpu python tools/chaos_router.py \
+        --remote --replicas 2 --sessions 4 --views 2 --json
 """
 
 from __future__ import annotations
@@ -83,13 +95,67 @@ def _build(args):
         step_retry_attempts=2, step_retry_backoff_s=0.05,
         degraded_recovery_steps=2, retry_after_s=0.2,
         replicas=args.replicas,
+        heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
         result_cache_entries=0))     # a soak must not replay results
     model = XUNet(cfg.model)
     params = init_params(model, cfg, jax.random.PRNGKey(0))
     sampler = Sampler(model, params, cfg)
     inj = FaultInjector(seed=args.seed)
-    service = FleetService.build(sampler, cfg, params_version="v0")
-    return service, inj, cfg, sampler
+    if args.remote:
+        service, procs = _build_remote_fleet(args, cfg)
+    else:
+        service = FleetService.build(sampler, cfg, params_version="v0")
+        procs = {}
+    return service, inj, cfg, sampler, procs
+
+
+def _build_remote_fleet(args, cfg):
+    """Spawn ``--replicas`` worker_cli subprocesses on disjoint CPU
+    device slices and front them with RemoteReplicas — the fleet shape
+    the in-process soak simulates, made real."""
+    import json as json_lib
+    import subprocess
+
+    from diff3d_tpu.serving import FleetService
+    from diff3d_tpu.serving.transport import RemoteReplica
+
+    n = args.replicas
+    host_devices = 8
+    if n > host_devices:
+        raise SystemExit(
+            f"--remote --replicas {n}: at most {host_devices} workers "
+            f"(one device each on the {host_devices}-virtual-device "
+            "CPU backend)")
+    per = host_devices // n
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)   # workers pick their own device count
+    procs = {}
+    for i in range(n):
+        lo, hi = i * per, (i + 1) * per - 1
+        cmd = [sys.executable, "-m", "diff3d_tpu.cli.worker_cli",
+               "--config", args.config, "--init", "random",
+               "--devices", f"{lo}-{hi}", "--port", "0",
+               "--name", f"w{i}", "--host_device_count",
+               str(host_devices), "--timeout_s", str(args.timeout_s),
+               "--max_views", "6"]
+        if args.compile_cache:
+            cmd += ["--compile_cache", args.compile_cache]
+        procs[f"w{i}"] = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True)
+    remotes = []
+    for name, proc in procs.items():
+        line = proc.stdout.readline()
+        if not line:
+            raise SystemExit(f"worker {name} died before its ready line")
+        ready = json_lib.loads(line)
+        print(f"chaos_router: worker {ready['name']} ready on "
+              f"port {ready['port']}", file=sys.stderr)
+        remotes.append(RemoteReplica(
+            "127.0.0.1", ready["port"], name=ready["name"],
+            heartbeat_interval_s=cfg.serving.heartbeat_interval_s,
+            heartbeat_timeout_s=cfg.serving.heartbeat_timeout_s))
+    return FleetService(remotes, cfg), procs
 
 
 def main(argv=None) -> int:
@@ -111,13 +177,21 @@ def main(argv=None) -> int:
                         "rejection (FleetOverloaded / ReplicaDraining)")
     p.add_argument("--no-kill", action="store_true",
                    help="skip the replica kill (rollout-only soak)")
+    p.add_argument("--remote", action="store_true",
+                   help="cross-process fleet: each replica is a real "
+                        "worker_cli subprocess on a disjoint CPU device "
+                        "slice; the kill is a real SIGKILL of the "
+                        "victim's process")
+    p.add_argument("--compile_cache", default=None,
+                   help="with --remote: shared persistent XLA "
+                        "compile-cache dir for the workers")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", action="store_true",
                    help="emit the survival report as one JSON line on "
                         "stdout")
     args = p.parse_args(argv)
 
-    service, inj, cfg, sampler = _build(args)
+    service, inj, cfg, sampler, worker_procs = _build(args)
     service.start(serve_http=False)
     router = service.router
 
@@ -127,12 +201,16 @@ def main(argv=None) -> int:
     from diff3d_tpu.serving.scheduler import SessionLost, ViewRequest
     from diff3d_tpu.testing.faults import arm_replica, replica_site
 
-    # Pre-compile the program shapes traffic will launch.  Replicas
-    # share the sampler's jit cache, so only the first warmup compiles.
+    # Pre-compile the program shapes traffic will launch.  In-process
+    # replicas share the sampler's jit cache, so only the first warmup
+    # compiles; remote workers compile in their own process on first
+    # traffic (or reuse --compile_cache).
     n_views = 3
     bucket = (cfg.model.H, cfg.model.W, record_capacity(n_views))
     t0 = time.perf_counter()
     for rep in service.replicas:
+        if not hasattr(rep, "engine"):
+            continue
         for lanes in {lane_count(n, rep.engine.max_batch,
                                  rep.engine.lane_multiple)
                       for n in (1, 2, rep.engine.max_batch)}:
@@ -142,7 +220,8 @@ def main(argv=None) -> int:
           f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     for rep in service.replicas:
-        arm_replica(rep, inj)
+        if hasattr(rep, "engine"):    # fault sites live in-process
+            arm_replica(rep, inj)
 
     counts = {"submitted": 0, "completed": 0, "failed_retryable": 0,
               "failed_other": 0, "hung": 0, "sessions_lost": 0}
@@ -231,17 +310,35 @@ def main(argv=None) -> int:
             break
         time.sleep(0.02)
     if victim is not None and not args.no_kill:
-        # Fire on the victim's next step dispatch, exactly once.
-        inj.add(replica_site(victim), kind="kill", first_n=1 << 30,
-                max_fires=1)
-        print(f"chaos_router: kill armed on {victim}", file=sys.stderr)
+        if args.remote:
+            # The real thing: SIGKILL the victim's worker process.  The
+            # router's heartbeat declares it dead within
+            # heartbeat_timeout_s; until then sticky submits surface
+            # retryable TransportErrors, after it typed SessionLost.
+            import signal
+            worker_procs[victim].send_signal(signal.SIGKILL)
+            print(f"chaos_router: SIGKILLed worker {victim} "
+                  f"(pid {worker_procs[victim].pid})", file=sys.stderr)
+        else:
+            # Fire on the victim's next step dispatch, exactly once.
+            inj.add(replica_site(victim), kind="kill", first_n=1 << 30,
+                    max_fires=1)
+            print(f"chaos_router: kill armed on {victim}",
+                  file=sys.stderr)
 
     rollout_box = {}
 
     def _rollout():
         time.sleep(0.3)
-        rollout_box.update(service.rollout(sampler.params, version="v1",
-                                           drain_timeout_s=60.0))
+        try:
+            rollout_box.update(service.rollout(sampler.params,
+                                               version="v1",
+                                               drain_timeout_s=60.0))
+        except Exception as e:  # SIGKILL between drain-ok and swap:
+            # the worker died mid-rollout; record it instead of leaving
+            # the box empty (which reads as "rollout never ran").
+            rollout_box.update(
+                {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
     ro = threading.Thread(target=_rollout, daemon=True)
     ro.start()
@@ -271,6 +368,17 @@ def main(argv=None) -> int:
     snap = service.metrics_snapshot()
     final_health = {r.name: r.health for r in service.replicas}
     service.stop()
+    for proc in worker_procs.values():
+        if proc.poll() is None:
+            proc.terminate()
+    for proc in worker_procs.values():
+        try:
+            proc.wait(timeout=15)
+        except Exception:
+            proc.kill()
+            proc.wait()
+        if proc.stdout is not None:
+            proc.stdout.close()
 
     c = snap["counters"]
     kill_armed = victim is not None and not args.no_kill
