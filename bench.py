@@ -79,7 +79,8 @@ _PARTIAL: dict = {}
 _PHASE_SEQUENCE = (
     "start", "dial", "train_srn64", "train_srn128", "sampler_srn64",
     "sampler_srn64_sharded", "sampler_steps_sweep", "sampler_srn128",
-    "sampler_srn128_sharded", "sampler128_steps_sweep", "complete",
+    "sampler_srn128_sharded", "sampler128_steps_sweep", "cascade_sweep",
+    "complete",
 )
 
 
@@ -445,6 +446,106 @@ def _sampler_steps_sweep(config: str = "srn64",
     }
 
 
+def _cascade_bench(config: str = "srn128", n_views: int = 2,
+                   plan_spec: str | None = None):
+    """Times the two cascade phases against the matched single-pass
+    sampler (DESIGN.md §20): one warmed run each of the draft pass, the
+    truncated refine pass, and the full-schedule single pass, same
+    views and key stream.  Returns ``(plan_spec, draft_s, refine_s,
+    single_s, n_eff)`` — raw seconds per phase plus the effective view
+    count the sweep divides by.
+    """
+    import jax
+    import numpy as np
+
+    from diff3d_tpu.cascade import CascadePlan, CascadeSampler
+    from diff3d_tpu.config import srn64_config, srn128_config
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.sampling.runtime import Sampler
+    from diff3d_tpu.train.trainer import init_params
+
+    cfg = {"srn64": srn64_config, "srn128": srn128_config}[config]()
+    H = cfg.model.H
+    if plan_spec is None:
+        plan_spec = (f"draft={H // 2}:ddim:8,"
+                     f"refine={H}:ancestral:64@t0.5")
+    plan = CascadePlan.parse(plan_spec)
+    rng = jax.random.PRNGKey(0)
+    model = XUNet(cfg.model)
+    params = init_params(model, cfg, rng)
+    cascade = CascadeSampler(model, params, cfg, plan)
+    single = Sampler(model, params, cfg)
+
+    s = cfg.model.H
+
+    def _views(seed):
+        r = np.random.RandomState(seed)
+        return {
+            "imgs": r.randn(n_views, cfg.model.H, cfg.model.W,
+                            3).astype(np.float32),
+            "R": np.broadcast_to(np.eye(3, dtype=np.float32),
+                                 (n_views, 3, 3)).copy(),
+            "T": r.randn(n_views, 3).astype(np.float32),
+            "K": np.array([[s * 1.2, 0, s / 2], [0, s * 1.2, s / 2],
+                           [0, 0, 1]], np.float32),
+        }
+
+    views = _views(0)
+    k_draft, k_refine = jax.random.split(rng)
+    # Warmup (compile) each phase, then time value-synced reruns.
+    drafts = cascade.synthesize_draft(views, k_draft, max_views=n_views)
+    t0 = time.perf_counter()
+    drafts = cascade.synthesize_draft(views, k_draft, max_views=n_views)
+    # graftlint: disable-next-line=GL106(synthesize fetches the record to host before returning - value-synced)
+    draft_s = time.perf_counter() - t0
+    cascade.refine_views(views, drafts, k_refine, max_views=n_views)
+    t0 = time.perf_counter()
+    cascade.refine_views(views, drafts, k_refine, max_views=n_views)
+    # graftlint: disable-next-line=GL106(refine_views block_until_ready-syncs its result)
+    refine_s = time.perf_counter() - t0
+    single.synthesize(views, rng, max_views=n_views)
+    t0 = time.perf_counter()
+    single.synthesize(views, rng, max_views=n_views)
+    # graftlint: disable-next-line=GL106(synthesize fetches the record to host before returning - value-synced)
+    single_s = time.perf_counter() - t0
+    return plan_spec, draft_s, refine_s, single_s, n_views - 1
+
+
+def _cascade_sweep(config: str = "srn128", n_views: int = 2,
+                   bench_fn=None) -> dict:
+    """Cascade serving economics: draft latency (time to first preview
+    frame), refine latency, and end-to-end s/view against the
+    single-pass full-schedule sampler at the same resolution.
+
+    ``bench_fn`` (default :func:`_cascade_bench`) is injectable so the
+    guard test can validate the record's structure without compiling
+    three samplers.
+    """
+    bench_fn = bench_fn or _cascade_bench
+    plan_spec, draft_s, refine_s, single_s, n_eff = bench_fn(
+        config, n_views=n_views)
+    e2e = draft_s + refine_s
+    return {
+        "metric": f"cascade_sweep_{config}",
+        "unit": "s/view",
+        "vs_baseline": None,   # reference has no cascade at all
+        "plan": plan_spec,
+        "n_views": n_views,
+        "effective_views": n_eff,
+        "draft_sec_per_view": round(draft_s / n_eff, 3),
+        "refine_sec_per_view": round(refine_s / n_eff, 3),
+        "end_to_end_sec_per_view": round(e2e / n_eff, 3),
+        "single_pass_sec_per_view": round(single_s / n_eff, 3),
+        "draft_raw_seconds": round(draft_s, 3),
+        "refine_raw_seconds": round(refine_s, 3),
+        "single_pass_raw_seconds": round(single_s, 3),
+        "speedup_vs_single_pass": (round(single_s / e2e, 2)
+                                   if e2e else None),
+        "preview_speedup": (round(single_s / draft_s, 2)
+                            if draft_s else None),
+    }
+
+
 def _acquire_backend(attempts: int = 6, wait_s: float = 75.0):
     """``jax.devices()`` via the shared retry shim.
 
@@ -741,6 +842,14 @@ def _bench_main() -> int:
         except Exception as e:
             payload["sampler128_steps"] = {
                 "error": str(e).splitlines()[0][:200]}
+        _enter_phase("cascade_sweep")
+        try:
+            # Cascade serving economics at full width: 64²-draft preview
+            # latency, truncated 128² refine latency, end-to-end s/view
+            # vs the single-pass 256-step sampler (DESIGN.md §20).
+            payload["cascade"] = _cascade_sweep("srn128", n_views=2)
+        except Exception as e:
+            payload["cascade"] = {"error": str(e).splitlines()[0][:200]}
 
     _enter_phase("complete")
     payload["phase_reached"] = "complete"

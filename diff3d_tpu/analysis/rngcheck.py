@@ -630,6 +630,24 @@ def build_step_many_ddim_events() -> List[str]:
                                         capacity=4))
 
 
+def build_step_many_cascade_draft_events() -> List[str]:
+    from diff3d_tpu.analysis import shardcheck
+
+    cascade, _env = shardcheck._cascade()
+    return _witnessed_lower(
+        lambda: cascade.draft.lower_step_many(
+            lanes=shardcheck.MESH_DEVICES, capacity=4))
+
+
+def build_step_many_cascade_refine_events() -> List[str]:
+    from diff3d_tpu.analysis import shardcheck
+
+    cascade, _env = shardcheck._cascade()
+    return _witnessed_lower(
+        lambda: cascade.refine.lower_step_many(
+            lanes=shardcheck.MESH_DEVICES, capacity=4))
+
+
 def build_loader_events() -> List[str]:
     return rngflow.loader_stream_events()
 
@@ -661,6 +679,17 @@ STREAM_REGISTRY: Dict[str, StreamSpec] = {
             "sampler step_many deterministic-DDIM stream (noise keys "
             "derived but unconsumed by design)",
             build_step_many_ddim_events),
+        StreamSpec(
+            "step_many_cascade_draft",
+            "cascade draft phase stream: the few-step student at the "
+            "draft resolution (its own split of the parent key)",
+            build_step_many_cascade_draft_events, tier1=True),
+        StreamSpec(
+            "step_many_cascade_refine",
+            "cascade refine phase stream: start_t-truncated scan — the "
+            "init-noise key is always drawn (renoising the draft), so "
+            "the stream matches the untruncated sampler's exactly",
+            build_step_many_cascade_refine_events, tier1=True),
     )
 }
 

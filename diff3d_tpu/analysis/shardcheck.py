@@ -214,6 +214,48 @@ def build_serving_warmup_report(
         expected_param_shardings=env.params(sampler.params))
 
 
+def _cascade():
+    """The cascade pair at analysis scale: a tiny 16² refine model whose
+    draft phase is the resolution-adapted 8² student — the same
+    construction serving uses, so the lowered programs carry the real
+    extra ``draft`` operand and truncated grid."""
+    import jax
+
+    from diff3d_tpu.cascade import CascadePlan, CascadeSampler
+    from diff3d_tpu.config import test_config
+    from diff3d_tpu.models import XUNet
+    from diff3d_tpu.train.trainer import init_params
+
+    cfg = test_config(imgsize=16, ch=8)
+    env = _fsdp_mesh()
+    model = XUNet(cfg.model)
+    params = init_params(model, cfg, jax.random.PRNGKey(0))
+    plan = CascadePlan.parse("draft=8:ddim:2,refine=16:ancestral:2@t0.5")
+    return CascadeSampler(model, params, cfg, plan, mesh=env), env
+
+
+def build_step_many_cascade_draft_report(
+        name: str = "step_many_cascade_draft") -> "ir.ProgramReport":
+    cascade, env = _cascade()
+    s = cascade.draft
+    lowered = s.lower_step_many(lanes=MESH_DEVICES, capacity=4)
+    return ir.analyze_lowered(
+        name, lowered, params_template=s.params,
+        params_argnum=0,
+        expected_param_shardings=env.params(s.params))
+
+
+def build_step_many_cascade_refine_report(
+        name: str = "step_many_cascade_refine") -> "ir.ProgramReport":
+    cascade, env = _cascade()
+    s = cascade.refine
+    lowered = s.lower_step_many(lanes=MESH_DEVICES, capacity=4)
+    return ir.analyze_lowered(
+        name, lowered, params_template=s.params,
+        params_argnum=0,
+        expected_param_shardings=env.params(s.params))
+
+
 REGISTRY: Dict[str, ProgramSpec] = {
     spec.name: spec for spec in (
         ProgramSpec(
@@ -237,6 +279,16 @@ REGISTRY: Dict[str, ProgramSpec] = {
             "serving_warmup",
             "serving-warmup view-step program routed via ProgramCache",
             build_serving_warmup_report),
+        ProgramSpec(
+            "step_many_cascade_draft",
+            "cascade draft phase: resolution-adapted student, few-step "
+            "DDIM at the draft resolution",
+            build_step_many_cascade_draft_report, tier1=True),
+        ProgramSpec(
+            "step_many_cascade_refine",
+            "cascade refine phase: start_t-truncated scan with the "
+            "upsampled-draft operand",
+            build_step_many_cascade_refine_report, tier1=True),
     )
 }
 

@@ -1,0 +1,11 @@
+from diff3d_tpu.cascade.plan import CascadePlan, PhaseSpec
+from diff3d_tpu.cascade.sampler import CascadeSampler, upsample_draft
+from diff3d_tpu.cascade.request import CascadeRequest
+
+__all__ = [
+    "CascadePlan",
+    "CascadeRequest",
+    "CascadeSampler",
+    "PhaseSpec",
+    "upsample_draft",
+]

@@ -14,6 +14,8 @@ Usage:
 
 Endpoints: ``POST /synthesize``, ``GET /result/<id>``, ``GET /healthz``,
 ``GET /metrics`` (text; ``?format=json`` for the structured snapshot).
+With ``--cascade``, ``POST /cascade`` serves progressive previews: draft
+frames stream first, refined frames replace them (DESIGN.md §20).
 """
 
 from __future__ import annotations
@@ -108,6 +110,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="split each view's diffusion scan into this many "
                         "device executions (must divide the per-view "
                         "step count)")
+    p.add_argument("--cascade", default=None, metavar="PLAN",
+                   help="serve progressive-preview cascades "
+                        "(POST /cascade): 'draft=RES:kind:steps,"
+                        "refine=RES:kind:steps@tSTART', e.g. "
+                        "'draft=64:ddim:8,refine=128:ancestral:64@t0.4'"
+                        " — the draft streams first at RES, then a "
+                        "truncated refine pass (from t=START) replaces "
+                        "each frame in place; refine RES must equal the "
+                        "config's image size")
     p.add_argument("--mesh", action="store_true",
                    help="shard serving over a device mesh (cfg.mesh): "
                         "the request batch's object axis rides the data "
@@ -232,6 +243,23 @@ def build_service(args):
     sampler = Sampler(model, params, cfg, scan_chunks=args.scan_chunks,
                       mesh=mesh_env, sampler_kind=args.sampler,
                       steps=args.sampler_steps)
+    cascade = None
+    if args.cascade:
+        from diff3d_tpu.cascade import CascadePlan, CascadeSampler
+
+        try:
+            plan = CascadePlan.parse(args.cascade)
+        except ValueError as e:
+            raise SystemExit(f"--cascade: {e}")
+        if plan.refine.resolution != cfg.model.H:
+            raise SystemExit(
+                f"--cascade: refine resolution {plan.refine.resolution} "
+                f"must equal the config's image size {cfg.model.H} "
+                f"(--config {args.config})")
+        cascade = CascadeSampler(model, params, cfg, plan, mesh=mesh_env)
+        logging.info("cascade plan %s (draft %d^2 -> refine %d^2 from "
+                     "t=%.2f)", plan.spec(), plan.draft.resolution,
+                     plan.refine.resolution, plan.refine.start_t)
     n_replicas = n_local
     extra_samplers = {}
     per_replica_extra = {}
@@ -283,21 +311,22 @@ def build_service(args):
             sampler, cfg, n_replicas,
             extra_samplers=extra_samplers or None,
             per_replica_extra=per_replica_extra or None,
-            params_version=version)
+            params_version=version, cascade=cascade)
         service = FleetService(local + _remotes(), cfg)
     elif n_replicas > 1:
         service = FleetService.build(
             sampler, cfg, n=n_replicas,
             extra_samplers=extra_samplers or None,
             per_replica_extra=per_replica_extra or None,
-            params_version=version)
+            params_version=version, cascade=cascade)
     else:
         if per_replica_extra:
             raise SystemExit(
                 "per-replica 'i@kind:steps' schedules require "
                 "--replicas > 1")
         service = ServingService(sampler, cfg, params_version=version,
-                                 extra_samplers=extra_samplers or None)
+                                 extra_samplers=extra_samplers or None,
+                                 cascade=cascade)
     if args.warmup:
         from diff3d_tpu.serving import Bucket
 
@@ -315,6 +344,15 @@ def build_service(args):
                                            s.w.shape[0])
                 logging.info("warmed bucket %s in %.1fs",
                              tuple(bucket), secs)
+            if eng.cascade is not None:
+                for phase, s in (("draft", eng.cascade.draft),
+                                 ("refine", eng.cascade.refine)):
+                    bucket = Bucket(s.cfg.model.H, s.cfg.model.W, cap,
+                                    s.steps, s.sampler_kind, phase)
+                    secs = eng.programs.warmup(bucket, s.lane_multiple,
+                                               s.w.shape[0])
+                    logging.info("warmed cascade %s bucket %s in %.1fs",
+                                 phase, tuple(bucket), secs)
     return service
 
 

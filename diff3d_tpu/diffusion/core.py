@@ -169,8 +169,40 @@ def ddim_step(eps_cond: jnp.ndarray, eps_uncond: jnp.ndarray,
     return alpha_next * z_start + sigma_next * eps
 
 
-def sample_schedule_ts(steps: int | None, *, timesteps: int) -> jnp.ndarray:
-    """The ``[k + 1]`` time grid for a ``k``-step sampling run.
+class ScheduleError(ValueError):
+    """A sampling-schedule parameter is off the valid grid — ``steps``
+    not a divisor of the dense schedule, or ``start_t`` not one of the
+    grid's time points."""
+
+
+def schedule_start_index(steps: int, start_t: float, *,
+                         timesteps: int) -> int:
+    """Index of ``start_t`` in the ``[steps + 1]`` grid of
+    :func:`sample_schedule_ts` (grid points ``t_i = 1 - i/steps``).
+
+    Truncated (draft-seeded) refinement must START on a grid point:
+    entering between points would evaluate logsnrs no full run ever
+    visits and silently break the exact-subset property the parity
+    oracle depends on.  Raises :class:`ScheduleError` for off-grid
+    ``start_t``, or one leaving no reverse steps (``start_t <= 0``).
+    """
+    start_t = float(start_t)
+    idx = round((1.0 - start_t) * steps)
+    if (not 0 <= idx < steps
+            or abs((1.0 - idx / steps) - start_t) > 1e-6):
+        pts = [round(1.0 - i / steps, 6) for i in range(steps)]
+        raise ScheduleError(
+            f"start_t={start_t} is not a grid point of the {steps}-step "
+            f"schedule (timesteps={timesteps}): valid start points are "
+            f"{pts} (start_t=1.0 runs the whole grid; 0.0 would leave "
+            "no reverse steps)")
+    return idx
+
+
+def sample_schedule_ts(steps: int | None, *, timesteps: int,
+                       start_t: float | None = None) -> jnp.ndarray:
+    """The time grid for a ``k``-step sampling run (``[k + 1]`` entries,
+    or the tail of them when ``start_t`` truncates the schedule).
 
     ``steps`` must divide ``timesteps`` (the dense grid size, 256 in the
     paper configs): the result is the stride-``timesteps // steps`` subset
@@ -178,15 +210,27 @@ def sample_schedule_ts(steps: int | None, *, timesteps: int) -> jnp.ndarray:
     EXACT index subset of the dense grid and ``steps == timesteps`` (stride
     1) reproduces the dense grid bit-for-bit — the ancestral parity oracle
     relies on that.  ``steps=None`` means the full grid.
+
+    ``start_t`` (cascade refinement) truncates the grid to ``[start_t, 0]``:
+    the caller renoises an upsampled draft to ``start_t`` via the forward
+    process and runs only the remaining reverse steps.  It must be one of
+    the grid's own time points (:func:`schedule_start_index`);
+    ``start_t=1.0`` is the untruncated grid, so the truncated path degrades
+    exactly to the full schedule.
     """
     if steps is None:
         steps = timesteps
     steps = int(steps)
     if steps < 1 or timesteps % steps:
-        raise ValueError(
+        divisors = [d for d in range(1, timesteps + 1) if timesteps % d == 0]
+        raise ScheduleError(
             f"steps={steps} must be a positive divisor of the dense "
-            f"schedule (timesteps={timesteps})")
-    return jnp.linspace(1.0, 0.0, timesteps + 1)[::timesteps // steps]
+            f"schedule (timesteps={timesteps}); valid step counts are "
+            f"{divisors}")
+    ts = jnp.linspace(1.0, 0.0, timesteps + 1)[::timesteps // steps]
+    if start_t is not None:
+        ts = ts[schedule_start_index(steps, start_t, timesteps=timesteps):]
+    return ts
 
 
 class SampleState(NamedTuple):
@@ -201,7 +245,9 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
                 rng: jax.Array, timesteps: int = 256,
                 logsnr_min: float = -20.0, logsnr_max: float = 20.0,
                 clip_x0: bool = True, steps: int | None = None,
-                sampler_kind: str = "ancestral") -> jnp.ndarray:
+                sampler_kind: str = "ancestral",
+                start_t: float | None = None,
+                draft: jnp.ndarray | None = None) -> jnp.ndarray:
     """Full reverse-diffusion for one novel view, as a single ``lax.scan``.
 
     Stochastic conditioning (reference ``sampling.py:129-155``): at every
@@ -221,6 +267,9 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
       steps: schedule subset size (see :func:`sample_schedule_ts`);
         ``None`` runs the full ``timesteps`` grid.
       sampler_kind: one of :data:`SAMPLER_KINDS`.
+      start_t / draft: truncated refinement — renoise the ``[B, H, W, 3]``
+        draft to grid point ``start_t`` and run only the remaining steps
+        (see :func:`sample_loop_prepare`).
     Returns:
       ``[B, H, W, 3]`` generated view.
     """
@@ -230,7 +279,8 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
     state, xs = sample_loop_prepare(
         record_len=record_len, rng=rng, timesteps=timesteps,
         shape=(w.shape[0],) + record_imgs.shape[-3:],
-        logsnr_min=logsnr_min, logsnr_max=logsnr_max, steps=steps)
+        logsnr_min=logsnr_min, logsnr_max=logsnr_max, steps=steps,
+        start_t=start_t, draft=draft)
     state = sample_loop_scan(
         denoise_fn, state, xs, record_imgs=record_imgs, record_R=record_R,
         record_T=record_T, target_R=target_R, target_T=target_T, K=K,
@@ -245,7 +295,9 @@ def sample_view(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
                 rng: jax.Array, timesteps: int = 256,
                 logsnr_min: float = -20.0, logsnr_max: float = 20.0,
                 clip_x0: bool = True, steps: int | None = None,
-                sampler_kind: str = "ancestral"):
+                sampler_kind: str = "ancestral",
+                start_t: float | None = None,
+                draft: jnp.ndarray | None = None):
     """One autoregressive view step over a DEVICE-RESIDENT record.
 
     The record-carry contract (the sampler's host loop never touches the
@@ -275,7 +327,7 @@ def sample_view(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
         target_R=record_R[record_len], target_T=record_T[record_len],
         K=K, w=w, rng=k, timesteps=timesteps, logsnr_min=logsnr_min,
         logsnr_max=logsnr_max, clip_x0=clip_x0, steps=steps,
-        sampler_kind=sampler_kind)
+        sampler_kind=sampler_kind, start_t=start_t, draft=draft)
     out2, record_imgs, record_len = sample_view_commit(
         record_imgs, record_len, out)
     return out2, record_imgs, record_len, rng
@@ -295,7 +347,9 @@ def sample_view_commit(record_imgs: jnp.ndarray, record_len: jnp.ndarray,
 
 def sample_loop_prepare(*, record_len: jnp.ndarray, rng: jax.Array,
                         timesteps: int, shape, logsnr_min: float,
-                        logsnr_max: float, steps: int | None = None):
+                        logsnr_max: float, steps: int | None = None,
+                        start_t: float | None = None,
+                        draft: jnp.ndarray | None = None):
     """Initial carry + per-step scan inputs for :func:`sample_loop_scan`.
 
     Splitting preparation from the scan lets a caller CHUNK the reverse
@@ -313,15 +367,32 @@ def sample_loop_prepare(*, record_len: jnp.ndarray, rng: jax.Array,
     regardless of ``steps``; at ``steps == timesteps`` every array here is
     bit-identical to the historical full-grid path, which is what keeps
     the 256-step ancestral sampler usable as a parity oracle.
+
+    ``start_t`` + ``draft`` (cascade refinement): the grid is truncated to
+    ``[start_t, 0]`` and the init image becomes the ``[B, H, W, 3]`` draft
+    renoised to ``start_t`` via the forward process (:func:`q_sample`)
+    using the SAME ``k_init`` draw the untruncated path spends on pure
+    noise — the key stream is schedule-independent either way.  At
+    ``start_t = 1.0`` the VP prior is exactly ``N(0, 1)``, so the draft is
+    ignored and the init is the untruncated path's noise bit-for-bit: a
+    stride-1-from-t=max cascade run equals the ancestral dense oracle.
     """
-    ts = sample_schedule_ts(steps, timesteps=timesteps)
+    ts = sample_schedule_ts(steps, timesteps=timesteps, start_t=start_t)
     n_steps = ts.shape[0] - 1
     logsnrs = logsnr_schedule_cosine(ts[:-1], logsnr_min=logsnr_min,
                                      logsnr_max=logsnr_max)
     logsnr_nexts = logsnr_schedule_cosine(ts[1:], logsnr_min=logsnr_min,
                                           logsnr_max=logsnr_max)
     rng, k_init, k_idx = jax.random.split(rng, 3)
-    init_img = jax.random.normal(k_init, shape)
+    noise = jax.random.normal(k_init, shape)
+    if draft is None or start_t is None or float(start_t) >= 1.0:
+        init_img = noise
+    else:
+        logsnr_start = logsnr_schedule_cosine(
+            jnp.asarray(start_t), logsnr_min=logsnr_min,
+            logsnr_max=logsnr_max)
+        init_img = q_sample(draft.astype(noise.dtype),
+                            jnp.full((shape[0],), logsnr_start), noise)
     # Pre-sampled stochastic-conditioning indices (reference
     # `random.choice(record)`, sampling.py:138) — computed up front so the
     # scan body is trace-static.

@@ -1,11 +1,13 @@
 from diff3d_tpu.evaluation.metrics import psnr, ssim
 from diff3d_tpu.evaluation.fid import (FIDStats, fid_from_stats,
                                        gaussian_stats, frechet_distance)
-from diff3d_tpu.evaluation.parity import PSNR_CAP, matched_seed_parity
+from diff3d_tpu.evaluation.parity import (PSNR_CAP, cascade_parity,
+                                           matched_seed_parity)
 from diff3d_tpu.evaluation.consistency import (plane_homography,
                                                reprojection_consistency,
                                                warp_frame)
 
 __all__ = ["psnr", "ssim", "FIDStats", "fid_from_stats", "gaussian_stats",
-           "frechet_distance", "PSNR_CAP", "matched_seed_parity",
+           "frechet_distance", "PSNR_CAP", "cascade_parity",
+           "matched_seed_parity",
            "plane_homography", "reprojection_consistency", "warp_frame"]

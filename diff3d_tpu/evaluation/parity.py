@@ -12,7 +12,7 @@ ancestral-256), with no confound from different noise draws.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -24,9 +24,22 @@ from diff3d_tpu.evaluation.metrics import psnr, ssim
 PSNR_CAP = 99.0
 
 
+def _resize_to(g: np.ndarray, hw: tuple) -> np.ndarray:
+    """Bilinearly resize ``[V, B, h, w, 3]`` generations to ``hw`` —
+    the same interpolation the cascade uses to upsample drafts, so a
+    draft scored against the full-resolution oracle is compared through
+    exactly the lens the refine pass sees it."""
+    import jax
+
+    shape = g.shape[:2] + (hw[0], hw[1]) + g.shape[4:]
+    return np.asarray(jax.image.resize(
+        np.asarray(g, np.float32), shape, method="bilinear"))
+
+
 def matched_seed_parity(gens: Sequence[np.ndarray],
                         oracle_gens: Sequence[np.ndarray],
-                        w_index: int = 0) -> dict:
+                        w_index: int = 0,
+                        resize: bool = False) -> dict:
     """PSNR/SSIM of per-object generations against matched-seed oracle
     generations.
 
@@ -35,6 +48,10 @@ def matched_seed_parity(gens: Sequence[np.ndarray],
         (any float dtype; B is the guidance sweep) produced with the same
         per-object keys by two samplers.
       w_index: guidance-sweep column to score.
+      resize: allow a resolution mismatch by bilinearly upsampling
+        ``gens`` to the oracle resolution before scoring (the cascade
+        draft-vs-128²-oracle comparison); view count and sweep must
+        still match.
     Returns:
       ``{"psnr", "psnr_std", "ssim", "views"}`` pooled over every view of
       every object (PSNR per-view values capped at :data:`PSNR_CAP`).
@@ -45,10 +62,14 @@ def matched_seed_parity(gens: Sequence[np.ndarray],
             "generations — the object lists must align")
     psnrs, ssims = [], []
     for g, o in zip(gens, oracle_gens):
+        if resize and g.shape[:2] == o.shape[:2] \
+                and g.shape[2:4] != o.shape[2:4]:
+            g = _resize_to(np.asarray(g), o.shape[2:4])
         if g.shape != o.shape:
             raise ValueError(
                 f"shape mismatch {g.shape} vs {o.shape}: matched-seed "
-                "runs must share view count, sweep, and resolution")
+                "runs must share view count, sweep, and resolution "
+                "(pass resize=True to score across resolutions)")
         if g.shape[0] == 0:
             continue
         a = np.asarray(g[:, w_index], np.float32)
@@ -62,4 +83,37 @@ def matched_seed_parity(gens: Sequence[np.ndarray],
         "psnr_std": round(float(np.std(psnrs)), 3),
         "ssim": round(float(np.mean(ssims)), 4),
         "views": len(psnrs),
+    }
+
+
+def cascade_parity(draft_gens: Sequence[np.ndarray],
+                   refined_gens: Sequence[np.ndarray],
+                   oracle_gens: Sequence[np.ndarray],
+                   w_index: int = 0,
+                   max_objects: Optional[int] = None) -> dict:
+    """Score a cascade run against the single-pass full-resolution
+    oracle, draft and refined side by side.
+
+    ``draft_gens`` are per-object draft-resolution generations
+    (upsampled here through the refine pass's own interpolation),
+    ``refined_gens`` the cascade's full-resolution outputs, and
+    ``oracle_gens`` matched-seed single-pass generations.  Returns
+    ``{"draft": {...}, "refined": {...}, "objects"}`` — each inner
+    block a :func:`matched_seed_parity` record, so the delta between
+    the two PSNRs is exactly what the truncated refinement buys.
+    """
+    if max_objects is not None:
+        draft_gens = list(draft_gens)[:max_objects]
+        refined_gens = list(refined_gens)[:max_objects]
+        oracle_gens = list(oracle_gens)[:max_objects]
+    if not (len(draft_gens) == len(refined_gens) == len(oracle_gens)):
+        raise ValueError(
+            f"{len(draft_gens)} draft vs {len(refined_gens)} refined vs "
+            f"{len(oracle_gens)} oracle objects — the lists must align")
+    return {
+        "draft": matched_seed_parity(draft_gens, oracle_gens,
+                                     w_index=w_index, resize=True),
+        "refined": matched_seed_parity(refined_gens, oracle_gens,
+                                       w_index=w_index),
+        "objects": len(oracle_gens),
     }

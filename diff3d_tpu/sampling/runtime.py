@@ -60,7 +60,7 @@ import numpy as np
 from diff3d_tpu.config import Config
 from diff3d_tpu.diffusion import (SAMPLER_KINDS, sample_loop_prepare,
                                   sample_loop_scan, sample_view,
-                                  sample_view_commit)
+                                  sample_view_commit, schedule_start_index)
 from diff3d_tpu.models import XUNet
 
 
@@ -129,12 +129,22 @@ class Sampler:
         :func:`~diff3d_tpu.diffusion.sample_schedule_ts`).  ``None``
         (default) runs the full grid, bit-identical to the historical
         sampler.
+      start_t: truncated-schedule (cascade refine) entry point — must be
+        a grid point of the ``steps``-step schedule.  When set, every
+        view step takes an extra ``[B, H, W, 3]`` ``draft`` operand: the
+        draft is renoised to ``start_t`` via the forward process and only
+        the remaining reverse steps run.  ``start_t=1.0`` ignores the
+        draft (the VP prior at t=1 is exactly N(0,1)) and reproduces the
+        untruncated sampler bit-for-bit.  Requires ``scan_chunks == 1``;
+        the offline ``synthesize*`` loops have no draft source and
+        refuse a truncated sampler.
     """
 
     def __init__(self, model: XUNet, params, cfg: Config,
                  scan_chunks: int = 1, mesh=None,
                  sampler_kind: str = "ancestral",
-                 steps: Optional[int] = None):
+                 steps: Optional[int] = None,
+                 start_t: Optional[float] = None):
         self.model = model
         self.cfg = cfg
         self.mesh = mesh
@@ -156,6 +166,17 @@ class Sampler:
                 f"scan_chunks={scan_chunks} must divide the effective "
                 f"step count steps={steps}")
         self.scan_chunks = scan_chunks
+        self.start_t = None if start_t is None else float(start_t)
+        self.start_index = 0
+        if self.start_t is not None:
+            # Raises ScheduleError for an off-grid start_t.
+            self.start_index = schedule_start_index(
+                steps, self.start_t, timesteps=d.timesteps)
+            if scan_chunks != 1:
+                raise ValueError(
+                    f"start_t={self.start_t} (truncated refinement) "
+                    f"requires scan_chunks=1, got {scan_chunks} — the "
+                    "chunk split assumes the full step count")
 
         # Sharding vocabulary.  lane_multiple is the divisibility quantum
         # of the object axis: NamedSharding rejects a leading dim not
@@ -189,14 +210,15 @@ class Sampler:
         # (out, record carry').  record_imgs is DONATED — the
         # dynamic_update_slice writes in place on device.
         def run_view(params, record_imgs, record_R, record_T, record_len,
-                     K, rng, constrain=None):
+                     K, rng, draft=None, constrain=None):
             return sample_view(
                 denoise_with(params, constrain), record_imgs=record_imgs,
                 record_R=record_R, record_T=record_T,
                 record_len=record_len, K=K, w=self.w, rng=rng,
                 timesteps=d.timesteps, logsnr_min=d.logsnr_min,
                 logsnr_max=d.logsnr_max, clip_x0=d.clip_x0,
-                steps=self.steps, sampler_kind=self.sampler_kind)
+                steps=self.steps, sampler_kind=self.sampler_kind,
+                start_t=self.start_t, draft=draft)
 
         def _specs(data_sharding, n_data_args, n_outs):
             """jit sharding kwargs (empty off-mesh)."""
@@ -209,7 +231,16 @@ class Sampler:
                                   if n_outs > 1 else data_sharding),
             }
 
-        if scan_chunks == 1:
+        if scan_chunks == 1 and self.start_t is not None:
+            # Truncated refinement: the draft rides as a trailing data
+            # operand so the program stays params-first (shardcheck's
+            # params_argnum contract).
+            self._run_view = jax.jit(
+                lambda p, ri, rR, rT, rl, K, rng, dr: run_view(
+                    p, ri, rR, rT, rl, K, rng, draft=dr,
+                    constrain=constrain),
+                donate_argnums=(1,), **_specs(self._rep, 7, 4))
+        elif scan_chunks == 1:
             self._run_view = jax.jit(
                 lambda p, ri, rR, rT, rl, K, rng: run_view(
                     p, ri, rR, rT, rl, K, rng, constrain=constrain),
@@ -284,7 +315,14 @@ class Sampler:
         # chips.  (The context-parallel constrain hook is single-object
         # only: under vmap its [B, F, H, W, C] spec would land on the
         # wrong axes.)
-        if scan_chunks == 1:
+        if scan_chunks == 1 and self.start_t is not None:
+            def run_view_draft(p, ri, rR, rT, rl, K, rng, dr):
+                return run_view(p, ri, rR, rT, rl, K, rng, draft=dr)
+            self._run_view_many = jax.jit(
+                jax.vmap(run_view_draft,
+                         in_axes=(None, 0, 0, 0, 0, 0, 0, 0)),
+                donate_argnums=(1,), **_specs(self._obj, 7, 4))
+        elif scan_chunks == 1:
             self._run_view_many = jax.jit(
                 jax.vmap(run_view, in_axes=(None, 0, 0, 0, 0, 0, 0)),
                 donate_argnums=(1,), **_specs(self._obj, 6, 4))
@@ -329,15 +367,32 @@ class Sampler:
     def model_calls_per_view(self) -> int:
         """Denoiser invocations per synthesised view (each reverse step is
         one 2B-batched CFG call) — the latency dial the step schedule
-        turns."""
-        return self.steps
+        turns.  A truncated (``start_t``) sampler runs only the grid tail,
+        so the truncated steps are subtracted."""
+        return self.steps - self.start_index
 
     # ------------------------------------------------------------------
     # Per-view step API (public): one view's full reverse diffusion.
     # ------------------------------------------------------------------
 
+    def _check_draft(self, draft, batched: bool):
+        """The draft operand is exactly as optional as ``start_t``: a
+        truncated sampler cannot run without one, an untruncated sampler
+        has no operand slot for one."""
+        if self.start_t is not None and draft is None:
+            raise ValueError(
+                f"this sampler was built with start_t={self.start_t}: "
+                "every view step needs the "
+                + ("[N, B, H, W, 3] drafts" if batched
+                   else "[B, H, W, 3] draft")
+                + " operand to renoise from")
+        if self.start_t is None and draft is not None:
+            raise ValueError(
+                "draft passed to an untruncated sampler — build the "
+                "Sampler with start_t to enable cascade refinement")
+
     def step(self, record_imgs, record_R, record_T, step, K, rng, *,
-             params=None):
+             draft=None, params=None):
         """One view's reverse diffusion for ONE object, device-resident.
 
         Args:
@@ -363,14 +418,17 @@ class Sampler:
           inputs are first copied into an XLA-owned buffer — see
           :meth:`_owned` — so the caller's array is unaffected).
         """
+        self._check_draft(draft, batched=False)
         p = self.params if params is None else params
-        return self._run_view(
-            p, self._owned(record_imgs), jnp.asarray(record_R),
-            jnp.asarray(record_T), jnp.asarray(step, jnp.int32),
-            jnp.asarray(K), jnp.asarray(rng))
+        args = (p, self._owned(record_imgs), jnp.asarray(record_R),
+                jnp.asarray(record_T), jnp.asarray(step, jnp.int32),
+                jnp.asarray(K), jnp.asarray(rng))
+        if self.start_t is not None:
+            args += (jnp.asarray(draft, jnp.float32),)
+        return self._run_view(*args)
 
     def step_many(self, record_imgs, record_R, record_T, steps, K, rngs,
-                  *, params=None):
+                  *, drafts=None, params=None):
         """One view step for N objects in ONE batched program.
 
         Everything gains a leading object axis; ``steps`` is ``[N]`` —
@@ -390,11 +448,14 @@ class Sampler:
                 f"data-axis size {self.lane_multiple} — pad the batch "
                 "(repeat a live lane; padded outputs are discarded) or "
                 "use synthesize_many, which pads internally")
+        self._check_draft(drafts, batched=True)
         p = self.params if params is None else params
-        return self._run_view_many(
-            p, self._owned(record_imgs), jnp.asarray(record_R),
-            jnp.asarray(record_T), jnp.asarray(steps, jnp.int32),
-            jnp.asarray(K), jnp.asarray(rngs))
+        args = (p, self._owned(record_imgs), jnp.asarray(record_R),
+                jnp.asarray(record_T), jnp.asarray(steps, jnp.int32),
+                jnp.asarray(K), jnp.asarray(rngs))
+        if self.start_t is not None:
+            args += (jnp.asarray(drafts, jnp.float32),)
+        return self._run_view_many(*args)
 
     def lower_step_many(self, lanes: int, capacity: int, *,
                         H: Optional[int] = None, W: Optional[int] = None):
@@ -425,14 +486,17 @@ class Sampler:
         sds = jax.ShapeDtypeStruct
         abstract_params = jax.tree.map(
             lambda x: sds(jnp.shape(x), x.dtype), self.params)
-        return self._run_view_many.lower(
+        abstract_args = [
             abstract_params,
             sds((lanes, capacity, B, H, W, 3), f32),
             sds((lanes, capacity, 3, 3), f32),
             sds((lanes, capacity, 3), f32),
             sds((lanes,), i32),
             sds((lanes, 3, 3), f32),
-            sds((lanes, 2), u32))
+            sds((lanes, 2), u32)]
+        if self.start_t is not None:
+            abstract_args.append(sds((lanes, B, H, W, 3), f32))
+        return self._run_view_many.lower(*abstract_args)
 
     # ------------------------------------------------------------------
     # Offline loops: thin host loops threading the device-resident carry.
@@ -473,6 +537,14 @@ class Sampler:
     def _put(self, x, sharding):
         return self._owned(x, sharding)
 
+    def _check_no_truncation(self, entry: str) -> None:
+        if self.start_t is not None:
+            raise ValueError(
+                f"{entry}: this sampler was built with start_t="
+                f"{self.start_t} (truncated refinement) and needs a draft "
+                "per view; the offline loops have no draft source — use "
+                "CascadeSampler (diff3d_tpu.cascade) or the step API")
+
     def synthesize(self, views: Dict[str, np.ndarray], rng: jax.Array,
                    out_dir: Optional[str] = None,
                    max_views: Optional[int] = None) -> np.ndarray:
@@ -488,6 +560,7 @@ class Sampler:
         ``{out_dir}/{step}/gt.png`` and ``{out_dir}/{step}/{i}.png`` per
         view — the reference's output layout (``sampling.py:179-182``).
         """
+        self._check_no_truncation("synthesize")
         imgs = np.asarray(views["imgs"], np.float32)
         R = np.asarray(views["R"], np.float32)
         T = np.asarray(views["T"], np.float32)
@@ -549,6 +622,7 @@ class Sampler:
         max_views)`` views — batch objects with equal view counts to avoid
         truncation.  Returns ``[N, n_views-1, B, H, W, 3]``.
         """
+        self._check_no_truncation("synthesize_many")
         N = len(views_list)
         assert N == len(rngs)
         n_views = min(v["imgs"].shape[0] for v in views_list)

@@ -101,6 +101,16 @@ class ProgramCache:
                 (getattr(sampler, "sampler_kind", None),
                  getattr(sampler, "steps", None)): sampler}
             self._sampler = sampler
+        # Cascade phase programs ride a SEPARATE registry keyed by the
+        # bucket's phase tag: a refine program takes an extra drafts
+        # operand, so it must never be reachable through the plain
+        # (kind, steps) schedule space even at identical shapes.
+        self._phase_samplers: Dict[str, object] = {}
+        # Per-phase params adapters (draft: resolution-adapt the served
+        # params) with an identity-memoized result, so a rollout's
+        # swapped params are re-adapted exactly once, not per view step.
+        self._phase_adapt: Dict[str, object] = {}
+        self._phase_adapted: Dict[str, tuple] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._programs: Dict[tuple, dict] = {}  # guarded-by: self._lock
         m = metrics
@@ -112,9 +122,48 @@ class ProgramCache:
             "view steps served by an already-compiled program") if m \
             else None
 
+    def register_phase(self, phase: str, sampler, adapt=None) -> None:
+        """Attach a cascade phase sampler: buckets tagged ``phase``
+        dispatch here instead of the schedule registry.  ``adapt``
+        (optional) maps the engine's current served params to this
+        phase's params — the draft phase resolution-adapts them; the
+        refine phase serves them as-is."""
+        if phase not in ("draft", "refine"):
+            raise ValueError(f"phase={phase!r} not in ('draft', 'refine')")
+        self._phase_samplers[phase] = sampler
+        if adapt is not None:
+            self._phase_adapt[phase] = adapt
+
+    def _phase_params(self, phase: str, params):
+        """The params a phase program should run with: the served params
+        through the phase's adapter, memoized by identity (one adaption
+        per swap, not per view step; the previous params generation is
+        dropped from the memo when a new one arrives)."""
+        adapt = self._phase_adapt.get(phase)
+        if adapt is None or params is None:
+            return params
+        with self._lock:
+            cached = self._phase_adapted.get(phase)
+            if cached is not None and cached[0] is params:
+                return cached[1]
+        adapted = adapt(params)
+        with self._lock:
+            self._phase_adapted[phase] = (params, adapted)
+        return adapted
+
     def _sampler_for(self, bucket):
         """The sampler serving ``bucket``'s schedule (default sampler for
-        legacy 3-tuple buckets / unresolved schedules)."""
+        legacy 3-tuple buckets / unresolved schedules; the phase registry
+        for cascade-tagged buckets)."""
+        phase = getattr(bucket, "phase", None)
+        if phase is not None:
+            try:
+                return self._phase_samplers[phase]
+            except KeyError:
+                raise KeyError(
+                    f"no {phase!r} phase sampler (bucket {tuple(bucket)}); "
+                    "the engine should have rejected this cascade at "
+                    "submit time")
         kind = getattr(bucket, "sampler", None)
         steps = getattr(bucket, "steps", None)
         if kind is None and steps is None:
@@ -136,12 +185,17 @@ class ProgramCache:
                 getattr(bucket, "steps", None))
 
     def step_many(self, bucket, lanes: int, record_imgs, record_R,
-                  record_T, steps, K, rngs, *, params=None):
+                  record_T, steps, K, rngs, *, params=None, drafts=None):
         """Run one batched view step (device-resident signature: the pose
         buffers carry every view's pose, ``rngs`` are per-lane PRNG
-        carries split inside).  Returns the sampler's full
-        ``(out, record_imgs, steps + 1, rngs)`` carry tuple."""
+        carries split inside).  ``drafts`` is the refine phase's
+        ``[N, B, H, W, 3]`` upsampled-draft operand (None elsewhere).
+        Returns the sampler's full ``(out, record_imgs, steps + 1,
+        rngs)`` carry tuple."""
         sampler = self._sampler_for(bucket)
+        phase = getattr(bucket, "phase", None)
+        if phase is not None:
+            params = self._phase_params(phase, params)
         key = (tuple(bucket), int(lanes))
         with self._lock:
             entry = self._programs.get(key)
@@ -161,8 +215,9 @@ class ProgramCache:
         if not first and self._hits:
             self._hits.inc()
         t0 = time.monotonic()
+        kw = {} if drafts is None else {"drafts": drafts}
         out = sampler.step_many(record_imgs, record_R, record_T,
-                                steps, K, rngs, params=params)
+                                steps, K, rngs, params=params, **kw)
         if first:
             out = jax.block_until_ready(out)
             with self._lock:
@@ -179,6 +234,8 @@ class ProgramCache:
                 return 0.0
         H, W, cap = tuple(bucket)[:3]
         N = int(lanes)
+        drafts = (np.zeros((N, guidance_B, H, W, 3), np.float32)
+                  if getattr(bucket, "phase", None) == "refine" else None)
         t0 = time.monotonic()
         out = self.step_many(
             bucket, lanes,
@@ -188,7 +245,7 @@ class ProgramCache:
             np.ones((N,), np.int32),
             np.zeros((N, 3, 3), np.float32),
             np.stack([np.asarray(jax.random.PRNGKey(i)) for i in range(N)]),
-            params=params)
+            params=params, drafts=drafts)
         jax.block_until_ready(out)
         return time.monotonic() - t0
 
@@ -263,6 +320,8 @@ class ProgramCache:
                     and (kind, steps) != default):
                 s += (f"x{kind or 'default'}"
                       f"{steps if steps is not None else ''}")
+            if len(b) >= 6 and b[5] is not None:
+                s += f"x{b[5]}"      # cascade phase tag
             return s + f"xlanes{lanes}"
 
         with self._lock:

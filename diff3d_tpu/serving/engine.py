@@ -116,8 +116,14 @@ class _Slot:
         self.step = 1                       # next view index to synthesise
         # Per-request PRNG carry; the per-view key split happens INSIDE
         # the compiled step (sample_view), preserving the offline loop's
-        # exact stream.
-        self.rng = np.asarray(jax.random.PRNGKey(req.seed))
+        # exact stream.  A cascade phase child carries an explicit key
+        # (its split of the parent stream) instead of PRNGKey(seed).
+        key = getattr(req, "rng_key", None)
+        self.rng = np.asarray(jax.random.PRNGKey(req.seed)
+                              if key is None else key)
+        # Refine-phase children carry the [n_views-1, B, H, W, 3]
+        # upsampled drafts their truncated scans renoise from.
+        self.drafts = getattr(req, "drafts", None)
         self.outs: List[np.ndarray] = []
 
 
@@ -129,7 +135,8 @@ class Engine:
                  params_registry: Optional[ParamsRegistry] = None,
                  result_cache: Optional[ResultCache] = None,
                  program_cache: Optional[ProgramCache] = None,
-                 extra_samplers: Optional[dict] = None):
+                 extra_samplers: Optional[dict] = None,
+                 cascade=None):
         self.sampler = sampler
         self.scheduler = scheduler
         self.metrics = metrics
@@ -156,6 +163,28 @@ class Engine:
             cfg.result_cache_entries, metrics)
         self.programs = program_cache or ProgramCache(
             self.samplers if len(self.samplers) > 1 else sampler, metrics)
+        # Cascade serving (DESIGN.md §20): a CascadeSampler contributes
+        # the two phase programs — requests reach them only through
+        # phase-tagged buckets, never through the (kind, steps) schedule
+        # registry, so plain clients cannot address them.
+        self.cascade = cascade
+        if cascade is not None:
+            from diff3d_tpu.convert.progressive import (
+                adapt_params_resolution)
+
+            dr = cascade.plan.draft.resolution
+            for phase, s, adapt in (
+                    ("draft", cascade.draft,
+                     lambda p, _dr=dr: adapt_params_resolution(
+                         p, (_dr, _dr))),
+                    ("refine", cascade.refine, None)):
+                if (getattr(s, "lane_multiple", 1)
+                        != getattr(sampler, "lane_multiple", 1)):
+                    raise ValueError(
+                        f"cascade {phase} sampler: lane_multiple differs "
+                        "from the default sampler's — all programs must "
+                        "share a mesh")
+                self.programs.register_phase(phase, s, adapt=adapt)
         self.guidance_B = int(sampler.w.shape[0])
         # Mesh quantum: every launched lane count must divide by the
         # sampler's data-axis size, including the admission ceiling.
@@ -226,6 +255,12 @@ class Engine:
         self._traj_active_g = m.gauge(
             "serving_active_trajectories",
             "trajectory requests admitted but not yet resolved")
+        self._cascade_requests = m.counter(
+            "serving_cascade_requests_total",
+            "cascade (progressive-preview) requests accepted")
+        self._cascade_frames = m.counter(
+            "serving_cascade_frames_total",
+            "cascade phase frames committed (draft + refine)")
         self._health_g = m.gauge(
             "serving_engine_health",
             "engine health (0=ok, 1=degraded, 2=draining)")
@@ -311,6 +346,55 @@ class Engine:
         if req.is_trajectory:
             self._traj_requests.inc()
         return self.scheduler.submit(req)
+
+    def supports_cascade(self, plan_spec: Optional[str] = None) -> bool:
+        """Would :meth:`submit_cascade` accept a request?  With a plan
+        spec, the replica must serve exactly that plan (cascade programs
+        are compiled at boot, never on client demand)."""
+        if self.cascade is None:
+            return False
+        return (plan_spec is None
+                or plan_spec == self.cascade.plan.spec())
+
+    def submit_cascade(self, req) -> "ViewRequest":
+        """Schedule a :class:`~diff3d_tpu.cascade.CascadeRequest`.
+
+        The parent never queues; its draft child is submitted now under
+        the ``(draft_resolution, "draft")`` bucket, and when every draft
+        view has resolved the refine child — carrying the upsampled
+        drafts — is chained in under ``(H, "refine")`` (the chaining
+        callback runs on the engine loop thread at the draft's retire).
+        The parent resolves with the refine child's result; any child
+        failure rejects the parent.
+        """
+        if self.cascade is None:
+            raise UnsupportedSchedule(
+                f"{req.id}: this replica serves no cascade plan",
+                supported=self.supported_schedules(),
+                retry_after_s=self.cfg.retry_after_s)
+        if req.plan.spec() != self.cascade.plan.spec():
+            raise UnsupportedSchedule(
+                f"{req.id}: cascade plan {req.plan.spec()} does not "
+                f"match the replica's {self.cascade.plan.spec()}",
+                supported=[self.cascade.plan.spec()],
+                retry_after_s=self.cfg.retry_after_s)
+
+        def chain_refine(draft_result: np.ndarray) -> None:
+            # Runs on the engine loop thread inside the draft child's
+            # _resolve; a submit failure propagates back into the
+            # child's resolve hook, which rejects the parent.
+            self.scheduler.submit(req.make_refine_child(draft_result))
+
+        draft = req.make_draft_child(chain_refine)
+        self._submitted.inc()
+        self._cascade_requests.inc()
+        req.submit_time = time.monotonic()
+        try:
+            self.scheduler.submit(draft)
+        except BaseException as e:
+            req._reject(e)
+            raise
+        return req
 
     def start(self) -> "Engine":
         if self._thread is not None:
@@ -682,6 +766,14 @@ class Engine:
 
         version, params = self.registry.current()
         bucket = active[0].req.bucket
+        # Refine-phase batches add the per-lane draft operand: lane i's
+        # scan renoises the draft of the view it is about to synthesise
+        # (slot.step is 1-based; drafts index 0 is view 1).
+        drafts = None
+        if bucket.phase == "refine":
+            drafts = np.stack([active[i].drafts[active[i].step - 1]
+                               for i in idx])
+            self._upload_bytes.inc(drafts.nbytes)
         t0 = time.monotonic()
 
         def _dispatch():
@@ -694,7 +786,7 @@ class Engine:
             try:
                 r = self.programs.step_many(
                     bucket, lanes, record_imgs, record_R, record_T,
-                    steps, Ks, rngs, params=params)
+                    steps, Ks, rngs, params=params, drafts=drafts)
                 return (np.asarray(jax.block_until_ready(r[0])),
                         np.asarray(r[3]))
             finally:
@@ -726,6 +818,8 @@ class Engine:
             slot.req._commit_frame(slot.step, view)
             if slot.req.is_trajectory:
                 self._traj_frames.inc()
+            if bucket.phase is not None:
+                self._cascade_frames.inc()
             slot.step += 1
         # One params version per launched batch; remember it for the
         # result-cache key of requests that finish this step.

@@ -68,13 +68,15 @@ class Replica:
 
     def __init__(self, name: str, sampler, cfg: Config,
                  extra_samplers: Optional[dict] = None,
-                 params_version: str = "v0"):
+                 params_version: str = "v0", cascade=None):
         """``extra_samplers`` maps ``(sampler_kind, steps)`` to extra
         Sampler instances (sharing ``sampler``'s params) — the
         schedules this replica serves beyond the default sampler's own
         (the PR 4 schedule registry, now per-replica so the router can
         place 8-step-DDIM traffic on distilled-student replicas and
-        parity traffic on teacher replicas)."""
+        parity traffic on teacher replicas).  ``cascade`` is an optional
+        :class:`~diff3d_tpu.cascade.CascadeSampler` enabling the
+        progressive-preview surface on this replica (DESIGN.md §20)."""
         cfg.serving.validate()
         self.name = str(name)
         self.cfg = cfg
@@ -96,7 +98,7 @@ class Replica:
                                      self.metrics),
             program_cache=ProgramCache(
                 samplers if len(samplers) > 1 else sampler, self.metrics),
-            extra_samplers=extra_samplers)
+            extra_samplers=extra_samplers, cascade=cascade)
         self._lock = threading.Lock()
         # Session record ledger: session_id -> requests served into that
         # session's record on THIS replica.  The router's zero-migration
@@ -170,6 +172,9 @@ class Replica:
     def supported_schedules(self) -> List[str]:
         return self.engine.supported_schedules()
 
+    def supports_cascade(self, plan_spec: Optional[str] = None) -> bool:
+        return self.engine.supports_cascade(plan_spec)
+
     @property
     def params_version(self) -> str:
         return self.registry.version
@@ -181,6 +186,19 @@ class Replica:
         only *accepted* requests — a rejected submit leaves no trace, so
         a failed first view does not pin the session here."""
         req = self.engine.submit(req)
+        if req.session_id is not None:
+            with self._lock:
+                self._session_records[req.session_id] = (
+                    self._session_records.get(req.session_id, 0) + 1)
+            self._records_ctr.inc()
+        return req
+
+    def submit_cascade(self, req) -> "ViewRequest":
+        """Cascade submit + session-record accounting.  The refine phase
+        conditions on (and extends) this replica's session record, so a
+        session-carrying cascade pins the session here exactly like a
+        plain view request."""
+        req = self.engine.submit_cascade(req)
         if req.session_id is not None:
             with self._lock:
                 self._session_records[req.session_id] = (
@@ -216,6 +234,8 @@ class Replica:
             "inflight": self.engine.inflight(),
             "params_version": self.registry.version,
             "supported_schedules": self.supported_schedules(),
+            "cascade": (self.engine.cascade.plan.spec()
+                        if self.engine.cascade is not None else None),
             "sessions": len(self.session_records()),
             "session_records_total": sum(
                 self.session_records().values()),
@@ -231,13 +251,16 @@ def build_fleet(sampler, cfg: Config, n: Optional[int] = None,
                 extra_samplers: Optional[dict] = None,
                 per_replica_extra: Optional[Dict[int, dict]] = None,
                 params_version: str = "v0",
-                name_prefix: str = "r") -> List[Replica]:
+                name_prefix: str = "r", cascade=None) -> List[Replica]:
     """Build ``n`` replicas (default ``cfg.serving.replicas``) sharing
     one sampler object (one jit cache -> one compile per program across
     the fleet).  ``extra_samplers`` applies to every replica;
     ``per_replica_extra[i]`` adds replica-``i``-only schedules — the
     heterogeneous-fleet shape (e.g. one distilled-student replica in a
-    teacher fleet)."""
+    teacher fleet).  A shared ``cascade``
+    (:class:`~diff3d_tpu.cascade.CascadeSampler`) enables the
+    progressive-preview surface fleet-wide, again paying one compile per
+    cascade program."""
     n = cfg.serving.replicas if n is None else int(n)
     if n < 1:
         raise ValueError(f"fleet size {n} must be >= 1")
@@ -247,5 +270,6 @@ def build_fleet(sampler, cfg: Config, n: Optional[int] = None,
         extra.update((per_replica_extra or {}).get(i, {}))
         replicas.append(Replica(f"{name_prefix}{i}", sampler, cfg,
                                 extra_samplers=extra or None,
-                                params_version=params_version))
+                                params_version=params_version,
+                                cascade=cascade))
     return replicas

@@ -62,7 +62,7 @@ from diff3d_tpu.serving.scheduler import (EngineDraining, EngineOverloaded,
                                           ReplicaDraining, ReplicaOverBudget,
                                           SessionLost, UnsupportedSchedule,
                                           ViewRequest)
-from diff3d_tpu.serving.server import (build_request,
+from diff3d_tpu.serving.server import (build_cascade_request, build_request,
                                        build_trajectory_request,
                                        make_http_server, remember_request,
                                        result_payload)
@@ -199,6 +199,16 @@ class Router:
         self._rejected_ctr.inc()
         return exc
 
+    @staticmethod
+    def _rep_submit(rep: Replica, req: ViewRequest) -> ViewRequest:
+        """One dispatch point for both request shapes: a cascade parent
+        goes through the replica's cascade surface (which derives and
+        chains the phase children), everything else through the plain
+        submit path."""
+        if getattr(req, "is_cascade", False):
+            return rep.submit_cascade(req)
+        return rep.submit(req)
+
     # -- request path -----------------------------------------------------
 
     def submit(self, req: ViewRequest) -> ViewRequest:
@@ -236,7 +246,7 @@ class Router:
                 f"the same session after {self.retry_after_s:g}s",
                 replica=owner, retry_after_s=self.retry_after_s))
         try:
-            return rep.submit(req)
+            return self._rep_submit(rep, req)
         except (QueueFullError, EngineOverloaded) as e:
             # Sticky requests cannot fail over — the record is here.
             raise self._reject(FleetOverloaded(
@@ -279,6 +289,14 @@ class Router:
                        sid: Optional[str]) -> ViewRequest:
         kind, steps = req.sampler_kind, req.steps
         cands = self._routable(kind, steps)
+        if getattr(req, "is_cascade", False):
+            spec = req.plan.spec()
+            cands = [r for r in cands if r.supports_cascade(spec)]
+            if not cands:
+                raise self._reject(UnsupportedSchedule(
+                    f"{req.id}: no live replica serves cascade plan "
+                    f"{spec} (boot replicas with --cascade)",
+                    retry_after_s=self.retry_after_s))
         if not cands:
             raise self._reject(self._no_candidates_exc(req, kind, steps))
         dead = [r.name for r in self.replica_list()
@@ -290,7 +308,7 @@ class Router:
         last: Optional[BaseException] = None
         for i, rep in enumerate(order):
             try:
-                got = rep.submit(req)
+                got = self._rep_submit(rep, req)
             except (QueueFullError, EngineOverloaded, EngineDraining,
                     ReplicaOverBudget) as e:
                 # ReplicaOverBudget: this replica's slice is out of HBM
@@ -321,7 +339,7 @@ class Router:
             # Lost the first-view race; the established claim wins.
             return self._submit_sticky(req, sid, owner)
         try:
-            got = chosen.submit(req)
+            got = self._rep_submit(chosen, req)
         except ReplicaOverBudget:
             # No record exists yet; release the claim exactly like the
             # capacity path, but re-raise the typed budget rejection
@@ -509,13 +527,14 @@ class FleetService:
     def build(cls, sampler, cfg: Config, n: Optional[int] = None,
               extra_samplers: Optional[dict] = None,
               per_replica_extra: Optional[Dict[int, dict]] = None,
-              params_version: str = "v0") -> "FleetService":
+              params_version: str = "v0", cascade=None) -> "FleetService":
         """One-call fleet: N replicas sharing ``sampler``'s jit cache
         (see :func:`~diff3d_tpu.serving.fleet.build_fleet`)."""
         return cls(build_fleet(sampler, cfg, n,
                                extra_samplers=extra_samplers,
                                per_replica_extra=per_replica_extra,
-                               params_version=params_version), cfg)
+                               params_version=params_version,
+                               cascade=cascade), cfg)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -569,6 +588,28 @@ class FleetService:
                          4 * self.cfg.serving.max_queue)
         return req
 
+    def submit_cascade(self, payload: dict) -> ViewRequest:
+        """Build + route a progressive-preview cascade.  The plan comes
+        from the fleet (the first cascade-capable replica's — replicas
+        built through :meth:`build` share one), never the payload; the
+        router then places the parent on a cascade-capable replica,
+        honouring session affinity exactly like a plain request."""
+        plan = None
+        for rep in self.replicas:
+            casc = getattr(rep.engine, "cascade", None)
+            if casc is not None:
+                plan = casc.plan
+                break
+        if plan is None:
+            raise UnsupportedSchedule(
+                "no replica in this fleet serves a cascade plan "
+                "(boot with --cascade)")
+        req = build_cascade_request(payload, self.cfg, plan)
+        self.router.submit(req)
+        remember_request(self._requests, self._requests_lock, req,  # lockcheck: disable=LC302(reference passed; remember_request locks)
+                         4 * self.cfg.serving.max_queue)
+        return req
+
     def get_request(self, request_id: str) -> Optional[ViewRequest]:
         with self._requests_lock:
             return self._requests.get(request_id)
@@ -609,6 +650,10 @@ class FleetService:
             "supported_schedules": sorted(
                 {s for r in reps if r.health != HEALTH_DEAD
                  for s in r.supported_schedules()}),
+            "cascade": sorted(
+                {r.engine.cascade.plan.spec() for r in reps
+                 if r.health != HEALTH_DEAD
+                 and getattr(r.engine, "cascade", None) is not None}),
         }
 
     def metrics_snapshot(self, include_memory: bool = False) -> dict:

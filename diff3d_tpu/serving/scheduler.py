@@ -46,6 +46,15 @@ class Bucket(NamedTuple):
     compatibility) means "the engine's default schedule" — the engine
     resolves them to concrete values at submit time, before any request
     reaches the scheduler or the program cache.
+
+    ``phase`` extends the key to the cascade's ``(resolution, phase)``
+    space (DESIGN.md §20): ``"draft"`` runs the low-resolution student
+    schedule, ``"refine"`` the truncated high-resolution one (its
+    program takes an extra drafts operand, so it can never share a
+    compilation with a plain view step even at equal shapes).  ``None``
+    — every non-cascade request — keeps the tuple positionally
+    backward compatible.  The resolution half of the cascade key is
+    already carried by ``H``/``W``.
     """
 
     H: int
@@ -53,6 +62,7 @@ class Bucket(NamedTuple):
     capacity: int
     steps: Optional[int] = None
     sampler: Optional[str] = None
+    phase: Optional[str] = None
 
 
 class QueueFullError(RuntimeError):
@@ -328,7 +338,8 @@ class ViewRequest:
         self.steps = int(steps)
         H, W = self._HW
         self.bucket = Bucket(H, W, record_capacity(self.n_views),
-                             self.steps, self.sampler_kind)
+                             self.steps, self.sampler_kind,
+                             self.bucket.phase)
 
     def content_key(self, params_version: str, extra: str = "") -> str:
         """Content hash for the result cache: identical inputs + seed +

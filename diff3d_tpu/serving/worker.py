@@ -65,13 +65,20 @@ log = logging.getLogger(__name__)
 
 #: Programs whose manifests a worker preloads: the serving step
 #: programs per sampler kind (the scan that renders views) plus the
-#: warmup trace.  ``step_many`` is the ancestral sampler's program;
-#: other kinds append their name (matching memcheck's registry).
-SERVING_PROGRAMS = ("step_many", "step_many_ddim", "serving_warmup")
+#: warmup trace and the two cascade phase programs (DESIGN.md §20).
+#: ``step_many`` is the ancestral sampler's program; other kinds append
+#: their name (matching memcheck's registry).
+SERVING_PROGRAMS = ("step_many", "step_many_ddim", "serving_warmup",
+                    "step_many_cascade_draft", "step_many_cascade_refine")
 
 
-def program_for_schedule(sampler_kind: Optional[str]) -> str:
-    """memcheck program name for a request's (resolved) sampler kind."""
+def program_for_schedule(sampler_kind: Optional[str],
+                         phase: Optional[str] = None) -> str:
+    """memcheck program name for a request's (resolved) sampler kind.
+    A cascade phase child maps to its phase program regardless of kind
+    — the phase, not the schedule, names the compiled scan."""
+    if phase is not None:
+        return f"step_many_cascade_{phase}"
     if sampler_kind in (None, "ancestral"):
         return "step_many"
     return f"step_many_{sampler_kind}"
@@ -113,6 +120,7 @@ class HbmAdmission:
         self._lock = threading.Lock()
         self._reserved: Dict[str, int] = {}  # guarded-by: self._lock
         self._rejects = 0  # guarded-by: self._lock
+        self._warned_unpinned: set = set()  # guarded-by: self._lock
         self.program_peaks: Dict[str, int] = {}
         self._load_manifests(manifest_dir)
 
@@ -137,26 +145,51 @@ class HbmAdmission:
         b = req.bucket
         return b.capacity * b.H * b.W * 3 * 4
 
-    def program_peak(self, sampler_kind: Optional[str]) -> int:
+    def program_peak(self, sampler_kind: Optional[str],
+                     phase: Optional[str] = None) -> int:
         """Manifest pin for the request's program; a kind with no
         committed manifest is charged the largest known pin (admission
-        must stay conservative for unpinned programs, not free)."""
-        peak = self.program_peaks.get(program_for_schedule(sampler_kind))
+        must stay conservative for unpinned programs, not free) — and
+        warns once per program name, so an unpinned cascade phase
+        riding the fallback is visible, not silent."""
+        program = program_for_schedule(sampler_kind, phase)
+        peak = self.program_peaks.get(program)
         if peak is not None:
             return peak
-        return max(self.program_peaks.values(), default=0)
+        fallback = max(self.program_peaks.values(), default=0)
+        with self._lock:
+            warn = program not in self._warned_unpinned
+            if warn:
+                self._warned_unpinned.add(program)
+        if warn:
+            log.warning(
+                "hbm admission: program %r has no committed memcheck "
+                "manifest pin — charging the largest known pin "
+                "(%d bytes); run `python -m diff3d_tpu.analysis.memcheck "
+                "--update` to pin it", program, fallback)
+        return fallback
 
     def admit(self, req: ViewRequest,
               default_kind: Optional[str] = None) -> None:
         """Reserve the request's footprint or raise
         :class:`ReplicaOverBudget` — atomic under the gate's lock, so
-        two concurrent submits can never both squeeze under the line."""
+        two concurrent submits can never both squeeze under the line.
+
+        Cascade work is charged its actual phase pin: a phase child
+        carries ``bucket.phase``, and a cascade parent (whose children
+        have not been derived yet) is charged the refine pin — the
+        full-resolution phase, i.e. the cascade's own peak — instead of
+        the cross-program largest-pin fallback."""
         if self.budget_bytes <= 0:
             return
         kind = req.sampler_kind if req.sampler_kind is not None \
             else default_kind
+        phase = getattr(req.bucket, "phase", None) \
+            if req.bucket is not None else None
+        if phase is None and getattr(req, "is_cascade", False):
+            phase = "refine"
         need = self.record_bytes(req)
-        peak = self.program_peak(kind)
+        peak = self.program_peak(kind, phase=phase)
         with self._lock:
             resident = sum(self._reserved.values())
             if resident + need + peak > self.budget_bytes:

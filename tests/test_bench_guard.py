@@ -145,6 +145,44 @@ def test_sampler_steps_sweep_structure():
         assert p["sec_per_view"] > 0 and p["effective_views"] == 3
 
 
+def test_cascade_sweep_structure():
+    """The cascade record: draft/refine/end-to-end s/view against the
+    matched single-pass sampler, with the preview speedup (single-pass
+    over draft latency) being the progressive-preview win and the plan
+    spec pinned next to the numbers."""
+    calls = []
+
+    def fake_bench(config, n_views):
+        calls.append((config, n_views))
+        # draft fast, refine mid, single-pass slowest — the shape a
+        # working cascade must have.
+        return ("draft=64:ddim:8,refine=128:ancestral:64@t0.5",
+                0.2, 1.0, 4.0, n_views - 1)
+
+    rec = bench._cascade_sweep("srn128", n_views=3, bench_fn=fake_bench)
+    assert rec["metric"] == "cascade_sweep_srn128"
+    assert calls == [("srn128", 3)]
+    assert rec["plan"] == "draft=64:ddim:8,refine=128:ancestral:64@t0.5"
+    assert rec["effective_views"] == 2
+    assert rec["draft_sec_per_view"] == 0.1
+    assert rec["refine_sec_per_view"] == 0.5
+    assert rec["end_to_end_sec_per_view"] == 0.6
+    assert rec["single_pass_sec_per_view"] == 2.0
+    # End-to-end still beats single-pass, and the draft preview beats
+    # it by much more — the whole point of the cascade.
+    assert rec["speedup_vs_single_pass"] > 1
+    assert rec["preview_speedup"] > rec["speedup_vs_single_pass"]
+    assert rec["unit"] == "s/view" and rec["vs_baseline"] is None
+
+
+def test_cascade_sweep_in_phase_sequence():
+    """The cascade sweep is a real phase: a round dying inside it must
+    report ``phase_reached == "cascade_sweep"`` in the partial record."""
+    seq = bench._PHASE_SEQUENCE
+    assert "cascade_sweep" in seq
+    assert seq.index("cascade_sweep") == seq.index("complete") - 1
+
+
 def test_main_emits_parseable_json_when_backend_never_comes_up(
         monkeypatch, capsys):
     import json

@@ -542,7 +542,9 @@ def test_cli_list_and_bad_invocation(capsys):
 
 
 def test_registry_names_and_tier1():
-    assert set(sc.TIER1_PROGRAMS) == {"train_step", "step_many"}
+    assert set(sc.TIER1_PROGRAMS) == {"train_step", "step_many",
+                                      "step_many_cascade_draft",
+                                      "step_many_cascade_refine"}
     assert set(sc.TIER1_PROGRAMS) <= set(sc.REGISTRY)
 
 

@@ -126,17 +126,19 @@ def _aggregate_snaps(snaps):
     return counters, hists
 
 
-def _build_fleet_or_single(sampler, cfg, args):
+def _build_fleet_or_single(sampler, cfg, args, cascade=None):
     """Fresh service per sweep point (clean metrics windows).  Returns
     ``(service, replicas_or_None, engines)``."""
     from diff3d_tpu.serving import FleetService, ServingService
 
     if args.replicas > 1:
-        service = FleetService.build(sampler, cfg, n=args.replicas)
+        service = FleetService.build(sampler, cfg, n=args.replicas,
+                                     cascade=cascade)
         service.start(serve_http=False)
         return service, service.replicas, [rep.engine
                                            for rep in service.replicas]
-    service = ServingService(sampler, cfg).start(serve_http=False)
+    service = ServingService(sampler, cfg,
+                             cascade=cascade).start(serve_http=False)
     return service, None, [service.engine]
 
 
@@ -399,6 +401,133 @@ def _run_trajectory(sampler, cfg, n_frames: int, args) -> dict:
     return point
 
 
+def _warmup_cascade(engines, cascade, n_views: int,
+                    n_requests: int) -> None:
+    """Warm both phase buckets at the lane counts cascade traffic will
+    launch (same rounding contract as :func:`_warmup`)."""
+    from diff3d_tpu.sampling import record_capacity
+    from diff3d_tpu.serving import Bucket
+    from diff3d_tpu.serving.engine import lane_count
+
+    cap = record_capacity(n_views)
+    buckets = []
+    for phase, s in (("draft", cascade.draft), ("refine", cascade.refine)):
+        H = s.cfg.model.H
+        buckets.append((Bucket(H, H, cap, s.steps, s.sampler_kind, phase),
+                        s.w.shape[0]))
+    for eng in engines:
+        for bucket, guidance_B in buckets:
+            for lanes in {lane_count(1, eng.max_batch, eng.lane_multiple),
+                          lane_count(min(eng.max_batch, n_requests or 1),
+                                     eng.max_batch, eng.lane_multiple)}:
+                eng.programs.warmup(bucket, lanes, guidance_B)
+
+
+def _run_cascade(sampler, cascade, cfg, rate: float, args) -> dict:
+    """One cascade sweep point: ``args.requests`` progressive-preview
+    requests at ``rate`` offered load, each drained by a streaming
+    client walking the phase-tagged event buffer — reporting
+    time-to-first-DRAFT-frame (the preview latency the cascade exists
+    for) and time-to-first-REFINED-frame percentiles next to the usual
+    end-to-end numbers."""
+    import numpy as np
+
+    service, replicas, engines = _build_fleet_or_single(
+        sampler, cfg, args, cascade=cascade)
+    fleet = replicas is not None
+    payloads = [{"views": _synthetic_views(args.n_views, cfg.model.H, i),
+                 "seed": i, "n_views": args.n_views}
+                for i in range(args.requests)]
+    _warmup_cascade(engines, cascade, args.n_views, args.requests)
+
+    lock = threading.Lock()
+    ttfds, ttfrs, latencies, errors = [], [], [], []
+
+    def drain(req, t_submit):
+        try:
+            sent, first_draft, first_refined = 0, None, None
+            while True:
+                events = req.wait_events(sent,
+                                         timeout=args.timeout_s + 30)
+                now = time.perf_counter() - t_submit
+                for e in events:
+                    if e["phase"] == "draft" and first_draft is None:
+                        first_draft = now
+                    if e["phase"] == "refine" and first_refined is None:
+                        first_refined = now
+                sent += len(events)
+                if not events:
+                    break
+            req.result(timeout=args.timeout_s + 30)
+            with lock:
+                ttfds.append(first_draft)
+                ttfrs.append(first_refined)
+                latencies.append(req.done_time - req.submit_time)
+        except Exception as e:
+            with lock:
+                errors.append(str(e))
+
+    t0 = time.perf_counter()
+    drainers = []
+    for payload in payloads:
+        t_submit = time.perf_counter()
+        try:
+            req = service.submit_cascade(payload)
+        except Exception as e:
+            errors.append(str(e))
+            continue
+        th = threading.Thread(target=drain, args=(req, t_submit),
+                              daemon=True)
+        th.start()
+        drainers.append(th)
+        if rate > 0:
+            time.sleep(1.0 / rate)
+    for th in drainers:
+        th.join()
+    wall = time.perf_counter() - t0
+
+    if fleet:
+        counters, hists = _aggregate_snaps(
+            [rep.metrics.snapshot() for rep in replicas])
+    else:
+        snap = service.metrics_snapshot()
+        counters, hists = snap["counters"], snap["histograms"]
+    service.stop()
+
+    def _pcts(xs):
+        a = np.asarray(sorted(x for x in xs if x is not None))
+        if not a.size:
+            return None, None
+        return (round(float(np.percentile(a, 50)), 3),
+                round(float(a[-1]), 3))
+
+    lat = np.asarray(sorted(latencies)) if latencies else np.zeros(0)
+    ttfd_p50, ttfd_max = _pcts(ttfds)
+    ttfr_p50, ttfr_max = _pcts(ttfrs)
+    occ = hists.get("serving_batch_occupancy", {})
+    return {
+        "offered_rate_rps": rate,
+        "requests": args.requests,
+        "completed": len(latencies),
+        "errors": len(errors),
+        "error_sample": errors[:3],
+        "wall_s": round(wall, 3),
+        "cascade_requests": counters.get(
+            "serving_cascade_requests_total", 0),
+        "cascade_frames": counters.get(
+            "serving_cascade_frames_total", 0),
+        "ttfd_p50_s": ttfd_p50,       # time to first DRAFT frame
+        "ttfd_max_s": ttfd_max,
+        "ttfr_p50_s": ttfr_p50,       # time to first REFINED frame
+        "ttfr_max_s": ttfr_max,
+        "latency_p50_s": (round(float(np.percentile(lat, 50)), 3)
+                          if lat.size else None),
+        "latency_p99_s": (round(float(np.percentile(lat, 99)), 3)
+                          if lat.size else None),
+        "occupancy_mean": round(occ.get("mean", 0.0), 3),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", choices=["srn64", "srn128", "test"],
@@ -439,6 +568,14 @@ def main(argv=None) -> int:
                         "--requests concurrent single-object "
                         "trajectories per point, streaming clients, "
                         "frames/s + time-to-first-frame vs. length")
+    p.add_argument("--cascade", default="",
+                   help="cascade plan spec, e.g. "
+                        "'draft=64:ddim:8,refine=128:ancestral:64@t0.4' "
+                        "(refine resolution must equal the config's); "
+                        "when set the bench runs the progressive-preview "
+                        "sweep over --rates: time-to-first-DRAFT-frame "
+                        "and time-to-first-REFINED-frame percentiles vs "
+                        "offered load")
     p.add_argument("--out", default="runs/bench_serving.json")
     args = p.parse_args(argv)
 
@@ -449,8 +586,26 @@ def main(argv=None) -> int:
         # (+1 for the conditioning view).
         args.n_views = max(args.n_views, max(traj_lens) + 1)
     sampler, cfg = _build_service(args)
+    cascade = None
+    if args.cascade:
+        from diff3d_tpu.cascade import CascadePlan, CascadeSampler
+
+        plan = CascadePlan.parse(args.cascade)
+        cascade = CascadeSampler(sampler.model, sampler.params, cfg,
+                                 plan, mesh=sampler.mesh)
     points = []
-    if traj_lens:
+    if cascade is not None:
+        rates = [float(r) for r in args.rates.split(",") if r.strip()]
+        for rate in rates:
+            print(f"bench_serving: cascade rate={rate} rps ...",
+                  file=sys.stderr)
+            pt = _run_cascade(sampler, cascade, cfg, rate, args)
+            print(f"bench_serving:   -> ttfd_p50={pt['ttfd_p50_s']}s "
+                  f"ttfr_p50={pt['ttfr_p50_s']}s "
+                  f"p50={pt['latency_p50_s']}s errors={pt['errors']}",
+                  file=sys.stderr)
+            points.append(pt)
+    elif traj_lens:
         for n_frames in traj_lens:
             print(f"bench_serving: trajectory {n_frames} frames x "
                   f"{args.requests} objects ...", file=sys.stderr)
@@ -473,8 +628,10 @@ def main(argv=None) -> int:
     import jax
 
     record = {
-        "bench": ("serving_trajectory_sweep" if traj_lens
+        "bench": ("serving_cascade_sweep" if cascade is not None
+                  else "serving_trajectory_sweep" if traj_lens
                   else "serving_offered_load"),
+        "cascade": args.cascade or None,
         "config": args.config,
         "platform": jax.devices()[0].platform,
         "num_devices": len(jax.devices()),
