@@ -80,8 +80,14 @@ _PHASE_SEQUENCE = (
     "start", "dial", "train_srn64", "train_srn128", "sampler_srn64",
     "sampler_srn64_sharded", "sampler_steps_sweep", "sampler_srn128",
     "sampler_srn128_sharded", "sampler128_steps_sweep", "cascade_sweep",
-    "complete",
+    "kernels_ab", "complete",
 )
+
+#: Kernel backends this round was asked to measure (``--kernels``).
+#: ``requested[0]`` is the primary — every phase runs with it; extra
+#: entries trigger the ``kernels_ab`` phase.  Module-level so partial
+#: records stamp WHICH kernel path was live when the round died.
+_KERNELS = {"requested": ["xla"]}
 
 
 def _enter_phase(name: str) -> None:
@@ -98,6 +104,7 @@ def _partial_record(reason: str) -> dict:
         "vs_baseline": None,
         "error": reason,
         "phase_reached": _PHASE["reached"],
+        "kernels": list(_KERNELS["requested"]),
         "dial": {"attempts": _LAST_DIAL["attempts"],
                  "retries": list(_LAST_DIAL["retries"])},
         "partial": dict(_PARTIAL),
@@ -105,7 +112,8 @@ def _partial_record(reason: str) -> dict:
 
 
 def _run(global_batch: int, n_steps: int, accum: int = 1,
-         config: str = "srn64", windows: int = 3):
+         config: str = "srn64", windows: int = 3,
+         kernels: str | None = None):
     import jax
 
     from diff3d_tpu.config import srn64_config, srn128_config
@@ -116,9 +124,12 @@ def _run(global_batch: int, n_steps: int, accum: int = 1,
     from diff3d_tpu.train.trainer import init_params
 
     cfg = {"srn64": srn64_config, "srn128": srn128_config}[config]()
+    model_over = {"remat": True}
+    if kernels is not None:
+        model_over["kernels"] = kernels     # groupnorm dispatch backend
     cfg = dataclasses.replace(
         cfg,
-        model=dataclasses.replace(cfg.model, remat=True),
+        model=dataclasses.replace(cfg.model, **model_over),
         train=dataclasses.replace(cfg.train, global_batch=global_batch,
                                   accum_steps=accum))
 
@@ -178,6 +189,7 @@ def _run(global_batch: int, n_steps: int, accum: int = 1,
         "step_ms_median": round(1e3 / median, 1),
         "steps_per_window": n_steps,
         "retried": retried,
+        "kernels": cfg.model.kernels,
     }
     # shardcheck comms report of the program just timed, so perf numbers
     # and collective counts travel in one JSON record (docs/DESIGN.md
@@ -224,7 +236,8 @@ def _run(global_batch: int, n_steps: int, accum: int = 1,
     return median, stats
 
 
-def _train_bench(configs, n_steps: int, config: str):
+def _train_bench(configs, n_steps: int, config: str,
+                 kernels: str | None = None):
     """Try ``(global_batch, accum)`` configs in order; returns
     ``(examples_per_sec, global_batch, accum, window_stats)``."""
     steps_per_sec, stats, global_batch, accum, err = None, None, None, 1, None
@@ -236,7 +249,7 @@ def _train_bench(configs, n_steps: int, config: str):
         for attempt in (0, 1):
             try:
                 steps_per_sec, stats = _run(global_batch, n_steps, accum,
-                                            config)
+                                            config, kernels=kernels)
                 break
             except Exception as e:
                 msg = str(e)
@@ -268,6 +281,7 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
                    object_batch: int = 1, use_mesh: bool = False,
                    sampler_kind: str = "ancestral",
                    steps: int | None = None,
+                   kernels: str | None = None,
                    comms_out: dict | None = None,
                    mem_out: dict | None = None,
                    rng_out: dict | None = None,
@@ -292,6 +306,11 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     schedule subset (``diffusion/core.py``): the default is the
     reference protocol above; ``("ddim", 16)`` times the few-step
     deterministic path the serving layer exposes.
+
+    ``kernels`` overrides the groupnorm dispatch backend
+    (``ops/dispatch.py``): ``"pallas"`` times the fused
+    GroupNorm->FiLM/SiLU Pallas path, ``"xla"`` the unfused reference;
+    ``None`` keeps the config default.
 
     ``comms_out``, when given a dict, is filled with the shardcheck
     comms summary of the batched view-step program (collective counts /
@@ -320,6 +339,9 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
     from diff3d_tpu.train.trainer import init_params
 
     cfg = {"srn64": srn64_config, "srn128": srn128_config}[config]()
+    if kernels is not None:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, kernels=kernels))
     model = XUNet(cfg.model)
     rng = jax.random.PRNGKey(0)
     # srn128 full width: one 256-step scan is a ~2-min device execution,
@@ -404,6 +426,7 @@ def _sampler_bench(config: str = "srn64", n_views: int = 4,
 def _sampler_steps_sweep(config: str = "srn64",
                          steps_list=(256, 64, 16, 8), n_views: int = 4,
                          object_batch: int = 1, use_mesh: bool = False,
+                         kernels: str | None = None,
                          bench_fn=None) -> dict:
     """Few-step sampling sweep: s/view of the deterministic DDIM sampler
     at each schedule subset, plus speedup relative to the first (full
@@ -423,7 +446,8 @@ def _sampler_steps_sweep(config: str = "srn64",
         spv, raw, n_eff = bench_fn(config, n_views=n_views,
                                    object_batch=object_batch,
                                    use_mesh=use_mesh,
-                                   sampler_kind="ddim", steps=steps)
+                                   sampler_kind="ddim", steps=steps,
+                                   kernels=kernels)
         points.append({
             "steps": steps,
             "sampler": "ddim",
@@ -442,6 +466,7 @@ def _sampler_steps_sweep(config: str = "srn64",
         "vs_baseline": None,   # reference has no few-step sampler at all
         "n_views": n_views,
         "object_batch": object_batch,
+        "kernels": kernels or "default",
         "points": points,
     }
 
@@ -546,6 +571,88 @@ def _cascade_sweep(config: str = "srn128", n_views: int = 2,
     }
 
 
+def _kernels_ab(kernels_list, *, config: str = "srn64",
+                configs=((8, 1),), n_steps: int = 3, n_views: int = 4,
+                train_fn=None, sampler_fn=None) -> dict:
+    """Head-to-head kernel-backend sweep: the SAME train step and the
+    SAME 256-step ancestral sampler timed once per requested backend
+    (``xla`` = unfused reference graph, ``pallas`` = fused
+    GroupNorm->FiLM/SiLU epilogues, ``ops/pallas_film.py``).  Variant 0
+    is the comparison base; later variants carry speedups relative to
+    it (train: higher examples/s is better; sampler: lower s/view is
+    better — both reported as >1 == variant wins).  A variant that
+    fails records a per-variant ``*_error`` note instead of voiding the
+    others — the A/B is diagnosable even when one backend can't compile.
+
+    ``train_fn`` / ``sampler_fn`` (default the real benches) are
+    injectable so the guard test can validate the record's structure
+    without compiling anything.
+    """
+    train_fn = train_fn or _train_bench
+    sampler_fn = sampler_fn or _sampler_bench
+    variants = []
+    for k in kernels_list:
+        v: dict = {"kernels": k}
+        try:
+            eps, gb, ac, stats = train_fn(list(configs), n_steps, config,
+                                          kernels=k)
+            v["train_examples_per_sec"] = round(eps, 2)
+            v["train_global_batch"] = gb
+            v["train_step_ms_median"] = stats.get("step_ms_median")
+        except Exception as e:
+            v["train_error"] = str(e).splitlines()[0][:200]
+        try:
+            spv, raw, n_eff = sampler_fn(config, n_views=n_views,
+                                         kernels=k)
+            v["sampler_sec_per_view"] = round(spv, 3)
+            v["sampler_raw_seconds"] = round(raw, 3)
+        except Exception as e:
+            v["sampler_error"] = str(e).splitlines()[0][:200]
+        variants.append(v)
+    base = variants[0]
+    for v in variants[1:]:
+        b_eps = base.get("train_examples_per_sec")
+        v_eps = v.get("train_examples_per_sec")
+        if b_eps and v_eps:
+            v[f"train_speedup_vs_{base['kernels']}"] = round(
+                v_eps / b_eps, 3)
+        b_spv = base.get("sampler_sec_per_view")
+        v_spv = v.get("sampler_sec_per_view")
+        if b_spv and v_spv:
+            v[f"sampler_speedup_vs_{base['kernels']}"] = round(
+                b_spv / v_spv, 3)
+    return {
+        "metric": f"kernels_ab_{config}",
+        "dimension": "kernels",
+        "unit": None,
+        "vs_baseline": None,   # reference has a single (unfused) path
+        "variants": variants,
+    }
+
+
+def _parse_args(argv):
+    """``--kernels`` is the only flag: a comma list of groupnorm dispatch
+    backends.  Entry 0 runs every phase; extra entries add the
+    ``kernels_ab`` head-to-head phase."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="bench.py",
+        description="Headline benchmark (see module docstring).")
+    p.add_argument(
+        "--kernels", default="xla",
+        help="comma list of groupnorm kernel backends to measure "
+             "(xla|pallas|auto); first entry drives all phases, extra "
+             "entries run the kernels_ab A/B sweep (e.g. 'xla,pallas')")
+    args = p.parse_args(list(argv))
+    ks = [k.strip() for k in args.kernels.split(",") if k.strip()]
+    bad = [k for k in ks if k not in ("xla", "pallas", "auto")]
+    if bad:
+        p.error(f"unknown kernel backend(s) {bad}; "
+                f"choose from xla, pallas, auto")
+    return ks or ["xla"]
+
+
 def _acquire_backend(attempts: int = 6, wait_s: float = 75.0):
     """``jax.devices()`` via the shared retry shim.
 
@@ -592,7 +699,7 @@ def _acquire_backend(attempts: int = 6, wait_s: float = 75.0):
     return devices
 
 
-def main() -> int:
+def main(argv=()) -> int:
     """Run the bench with an always-parseable exit: a SIGTERM from the
     harness (``timeout`` sends TERM before KILL — round r05 died to
     exactly this with no record) or an unexpected exception both emit a
@@ -601,6 +708,7 @@ def main() -> int:
     (tests, a driving trainer) keeps its own handlers."""
     _PHASE["reached"] = "start"
     _PARTIAL.clear()
+    _KERNELS["requested"] = _parse_args(argv)
 
     def _on_term(signum, frame):  # pragma: no cover - signal path
         print(json.dumps(_partial_record(
@@ -674,6 +782,8 @@ def _bench_main() -> int:
     platform = devices[0].platform
     ndev = len(devices)
     on_accel = platform != "cpu"
+    kernels_list = list(_KERNELS["requested"])
+    primary = kernels_list[0]
     # srn64 configs in preference order: the reference's exact global batch
     # 128 (2 accumulation microbatches fit one 16G chip), then direct
     # smaller batches.  CPU fallback (no accelerator): tiny so the bench
@@ -684,7 +794,7 @@ def _bench_main() -> int:
     _enter_phase("train_srn64")
     try:
         examples_per_sec, global_batch, accum, stats = _train_bench(
-            configs, n_steps, "srn64")
+            configs, n_steps, "srn64", kernels=primary)
     except Exception as e:
         print(json.dumps({
             "metric": f"train_examples_per_sec_srn64_{platform}_x{ndev}",
@@ -706,6 +816,7 @@ def _bench_main() -> int:
         "unit": "examples/s",
         "vs_baseline": round(examples_per_sec / BASELINE_EXAMPLES_PER_SEC,
                              4),
+        "kernels": primary,
         "windows": stats,
     })
 
@@ -715,8 +826,8 @@ def _bench_main() -> int:
     if on_accel:
         _enter_phase("train_srn128")
         try:
-            eps128, gb128, ac128, stats128 = _train_bench([(16, 4), (8, 4)],
-                                                          5, "srn128")
+            eps128, gb128, ac128, stats128 = _train_bench(
+                [(16, 4), (8, 4)], 5, "srn128", kernels=primary)
             payload["srn128"] = {
                 "metric": f"train_examples_per_sec_srn128_b{gb128}x"
                           f"{ac128}accum_{platform}_x{ndev}",
@@ -734,13 +845,14 @@ def _bench_main() -> int:
             rng_stream: dict = {}
             sem: dict = {}
             sec_per_view, raw_s, n_eff = _sampler_bench(
-                comms_out=comms, mem_out=mem, rng_out=rng_stream,
-                sem_out=sem)
+                kernels=primary, comms_out=comms, mem_out=mem,
+                rng_out=rng_stream, sem_out=sem)
             payload["sampler"] = {
                 "metric": f"sampler_sec_per_view_srn64_{platform}",
                 "value": round(sec_per_view, 2),
                 "unit": "s/view",
                 "vs_baseline": None,   # reference published no timing
+                "kernels": primary,
                 "raw_seconds": round(raw_s, 2),
                 "effective_views": n_eff,
                 "chips_used": 1,
@@ -763,7 +875,7 @@ def _bench_main() -> int:
                 sh_rng: dict = {}
                 sh_sem: dict = {}
                 sh_spv, sh_raw, sh_eff = _sampler_bench(
-                    object_batch=ndev, use_mesh=True,
+                    object_batch=ndev, use_mesh=True, kernels=primary,
                     comms_out=sh_comms, mem_out=sh_mem,
                     rng_out=sh_rng, sem_out=sh_sem)
                 payload["sampler"]["sharded"] = {
@@ -787,7 +899,8 @@ def _bench_main() -> int:
         try:
             # Few-step DDIM sweep at srn64: how wall-clock tracks the
             # 256 -> 8 model-call reduction on real hardware.
-            payload["sampler_steps"] = _sampler_steps_sweep()
+            payload["sampler_steps"] = _sampler_steps_sweep(
+                kernels=primary)
         except Exception as e:
             payload["sampler_steps"] = {"error": str(e).splitlines()[0][:200]}
         _enter_phase("sampler_srn128")
@@ -801,13 +914,14 @@ def _bench_main() -> int:
             # (ADVICE r4): raw_seconds is the wall time of ONE batched
             # scan pass, value = raw_seconds / effective_views.
             sec_per_view128, raw_s128, n_eff128 = _sampler_bench(
-                "srn128", n_views=2, object_batch=2)
+                "srn128", n_views=2, object_batch=2, kernels=primary)
             payload["sampler128"] = {
                 "metric": f"sampler_sec_per_view_srn128_objbatch2_"
                           f"{platform}",
                 "value": round(sec_per_view128, 2),
                 "unit": "s/view",
                 "vs_baseline": None,   # reference cannot run 128^2 at all
+                "kernels": primary,
                 "raw_seconds": round(raw_s128, 2),
                 "effective_views": n_eff128,
                 "chips_used": 1,
@@ -819,7 +933,8 @@ def _bench_main() -> int:
             _enter_phase("sampler_srn128_sharded")
             try:
                 sh_spv, sh_raw, sh_eff = _sampler_bench(
-                    "srn128", n_views=2, object_batch=ndev, use_mesh=True)
+                    "srn128", n_views=2, object_batch=ndev, use_mesh=True,
+                    kernels=primary)
                 payload["sampler128"]["sharded"] = {
                     "chips_used": ndev,
                     "sec_per_view": round(sh_spv, 2),
@@ -838,7 +953,7 @@ def _bench_main() -> int:
             # Same sweep at the full-width 128^2 config (object-batched
             # like the sampler128 block so the scan stays amortised).
             payload["sampler128_steps"] = _sampler_steps_sweep(
-                "srn128", n_views=2, object_batch=2)
+                "srn128", n_views=2, object_batch=2, kernels=primary)
         except Exception as e:
             payload["sampler128_steps"] = {
                 "error": str(e).splitlines()[0][:200]}
@@ -851,6 +966,29 @@ def _bench_main() -> int:
         except Exception as e:
             payload["cascade"] = {"error": str(e).splitlines()[0][:200]}
 
+    if len(kernels_list) > 1:
+        if on_accel:
+            _enter_phase("kernels_ab")
+            try:
+                # Re-time the srn64 train step and sampler per backend at
+                # the batch config the primary phase settled on, so the
+                # A/B rides one known-good config instead of re-walking
+                # the fallback ladder per variant.
+                payload["kernels_ab"] = _kernels_ab(
+                    kernels_list, configs=[(global_batch, accum)],
+                    n_steps=n_steps)
+            except Exception as e:
+                payload["kernels_ab"] = {
+                    "error": str(e).splitlines()[0][:200]}
+        else:
+            # CPU has no Pallas backend: the fused path would run in
+            # interpret mode, which is a correctness harness, not a perf
+            # measurement (tools/bench_kernels.py --interpret is the
+            # committed CPU smoke for that).
+            payload["kernels_ab"] = {
+                "skipped": "cpu: interpret-mode pallas is not a perf "
+                           "measurement; see tools/bench_kernels.py"}
+
     _enter_phase("complete")
     payload["phase_reached"] = "complete"
     print(json.dumps(payload))
@@ -858,4 +996,4 @@ def _bench_main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

@@ -621,6 +621,15 @@ def build_step_many_events() -> List[str]:
                                         capacity=4))
 
 
+def build_step_many_pallas_events() -> List[str]:
+    from diff3d_tpu.analysis import shardcheck
+
+    sampler, _env = shardcheck._sampler(kernels="pallas")
+    return _witnessed_lower(
+        lambda: sampler.lower_step_many(lanes=shardcheck.MESH_DEVICES,
+                                        capacity=4))
+
+
 def build_step_many_ddim_events() -> List[str]:
     from diff3d_tpu.analysis import shardcheck
 
@@ -669,6 +678,12 @@ STREAM_REGISTRY: Dict[str, StreamSpec] = {
             "InfiniteLoader SeedSequence spawn tree: global batch as "
             "a pure function of (seed, step, slot), both sample modes",
             build_loader_events, tier1=True),
+        StreamSpec(
+            "step_many_pallas",
+            "sampler step_many with fused GroupNorm Pallas kernels — "
+            "the kernels consume no keys, so this stream must be "
+            "byte-identical to step_many's",
+            build_step_many_pallas_events),
         StreamSpec(
             "distill_step",
             "progressive-distillation step: teacher/student stream "

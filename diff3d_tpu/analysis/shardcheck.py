@@ -165,7 +165,10 @@ def build_distill_step_report(
 
 
 def _sampler(sampler_kind: str = "ancestral",
-             steps: Optional[int] = None):
+             steps: Optional[int] = None,
+             kernels: Optional[str] = None):
+    import dataclasses
+
     import jax
 
     from diff3d_tpu.config import test_config
@@ -174,6 +177,9 @@ def _sampler(sampler_kind: str = "ancestral",
     from diff3d_tpu.train.trainer import init_params
 
     cfg = test_config(imgsize=8, ch=8)
+    if kernels is not None:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, kernels=kernels))
     env = _fsdp_mesh()
     model = XUNet(cfg.model)
     params = init_params(model, cfg, jax.random.PRNGKey(0))
@@ -183,6 +189,20 @@ def _sampler(sampler_kind: str = "ancestral",
 
 def build_step_many_report(name: str = "step_many") -> "ir.ProgramReport":
     sampler, env = _sampler()
+    lowered = sampler.lower_step_many(lanes=MESH_DEVICES, capacity=4)
+    return ir.analyze_lowered(
+        name, lowered, params_template=sampler.params,
+        params_argnum=0,
+        expected_param_shardings=env.params(sampler.params))
+
+
+def build_step_many_pallas_report(
+        name: str = "step_many_pallas") -> "ir.ProgramReport":
+    """step_many with the fused GroupNorm->FiLM/SiLU Pallas kernels
+    (interpret-mode lowering on the CPU mesh).  Not tier-1 — the
+    interpret-mode pallas_call lowering is several times slower to trace
+    than the XLA path, so the lint gate pins it out-of-band."""
+    sampler, env = _sampler(kernels="pallas")
     lowered = sampler.lower_step_many(lanes=MESH_DEVICES, capacity=4)
     return ir.analyze_lowered(
         name, lowered, params_template=sampler.params,
@@ -267,6 +287,11 @@ REGISTRY: Dict[str, ProgramSpec] = {
             "sharded sampler step_many, ancestral full grid "
             "(8 lanes, capacity 4)",
             build_step_many_report, tier1=True),
+        ProgramSpec(
+            "step_many_pallas",
+            "sharded sampler step_many with fused GroupNorm Pallas "
+            "kernels (interpret-mode lowering)",
+            build_step_many_pallas_report),
         ProgramSpec(
             "distill_step",
             "mesh-sharded progressive-distillation step",

@@ -125,6 +125,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "axis, params follow the configured "
                         "replicated/fsdp policy; lane counts round up to "
                         "the data-axis size")
+    p.add_argument("--pallas", action="store_true",
+                   help="route the GroupNorm->FiLM/SiLU epilogues through "
+                        "the fused Pallas kernels (ops/pallas_film.py; "
+                        "interpret mode off-TPU).  Equivalent to "
+                        "model.kernels='pallas'")
     p.add_argument("--raw_params", action="store_true",
                    help="serve raw params instead of EMA")
     p.add_argument("--warmup", action="store_true",
@@ -155,6 +160,9 @@ def build_service(args):
             cfg, diffusion=dataclasses.replace(cfg.diffusion,
                                                timesteps=args.steps))
     cfg = apply_model_width_overrides(cfg, args)
+    if args.pallas:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, kernels="pallas"))
     over = {k: getattr(args, k) for k in
             ("host", "port", "max_batch", "max_queue")
             if getattr(args, k) is not None}

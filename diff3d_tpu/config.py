@@ -57,6 +57,13 @@ class ModelConfig:
     # per site is a measured question (tools/profile128.py), not one a
     # single global attn_impl can answer.
     attn_impl_levels: Optional[Sequence[str]] = None
+    # Kernel backend for the fused GroupNorm->FiLM/SiLU epilogues
+    # (ops/pallas_film.py via ops/dispatch.py): 'xla' (default) keeps the
+    # plain composition — bit-identical graphs to pre-kernel-layer
+    # checkpoints; 'pallas' forces the fused kernels (interpret mode
+    # off-TPU, so CPU tests exercise the TPU tile program); 'auto' uses
+    # pallas only on a TPU-default-backend process.  CLI: --pallas.
+    kernels: str = "xla"
 
     @property
     def num_resolutions(self) -> int:
@@ -86,6 +93,10 @@ class ModelConfig:
                     or (impl.partition(":")[0] in ("ring", "ulysses")
                         and bool(impl.partition(":")[2])))
 
+        if self.kernels not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"kernels={self.kernels!r} not in ('auto', 'pallas', "
+                "'xla')")
         if not _impl_ok(self.attn_impl):
             raise ValueError(
                 f"attn_impl={self.attn_impl!r}: expected 'auto', 'pallas', "

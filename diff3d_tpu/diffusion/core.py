@@ -247,7 +247,8 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
                 clip_x0: bool = True, steps: int | None = None,
                 sampler_kind: str = "ancestral",
                 start_t: float | None = None,
-                draft: jnp.ndarray | None = None) -> jnp.ndarray:
+                draft: jnp.ndarray | None = None,
+                hoist_cond: bool = True) -> jnp.ndarray:
     """Full reverse-diffusion for one novel view, as a single ``lax.scan``.
 
     Stochastic conditioning (reference ``sampling.py:129-155``): at every
@@ -285,7 +286,7 @@ def sample_loop(denoise_fn: DenoiseFn, *, record_imgs: jnp.ndarray,
         denoise_fn, state, xs, record_imgs=record_imgs, record_R=record_R,
         record_T=record_T, target_R=target_R, target_T=target_T, K=K,
         w=w, logsnr_max=logsnr_max, clip_x0=clip_x0,
-        deterministic=(sampler_kind == "ddim"))
+        deterministic=(sampler_kind == "ddim"), hoist_cond=hoist_cond)
     return state.img
 
 
@@ -405,7 +406,8 @@ def sample_loop_scan(denoise_fn: DenoiseFn, state: SampleState, xs, *,
                      record_T: jnp.ndarray, target_R: jnp.ndarray,
                      target_T: jnp.ndarray, K: jnp.ndarray, w: jnp.ndarray,
                      logsnr_max: float, clip_x0: bool,
-                     deterministic: bool = False) -> SampleState:
+                     deterministic: bool = False,
+                     hoist_cond: bool = True) -> SampleState:
     """``lax.scan`` the reverse steps in ``xs`` from ``state`` (a full
     run, or one chunk of it — see :func:`sample_loop_prepare`).
 
@@ -414,12 +416,29 @@ def sample_loop_scan(denoise_fn: DenoiseFn, state: SampleState, xs, *,
     (``rng, k_x, k_noise``) so the uncond-frame draws and the downstream
     key stream are shared between samplers at matched seeds — the DDIM
     path simply never consumes ``k_noise``.
+
+    ``hoist_cond`` precomputes the intrinsics-only conditioning stage
+    (``pinhole_rays_cam``: the K_inv @ pixel-grid contraction, constant
+    across the trajectory's steps) once before the scan and feeds it to
+    the model as ``batch['cam_dirs']`` — certified loop-invariant by
+    ``equiv.verify_hoist`` and bit-exact vs the unhoisted body (the
+    rngcheck stream manifests are byte-identical either way).  False
+    keeps the in-loop computation (the equivalence oracle).
     """
     B = w.shape[0]
 
     Kb = jnp.broadcast_to(K[None], (B, 3, 3))
     w_mask_2b = jnp.concatenate(
         [jnp.ones((B,), bool), jnp.zeros((B,), bool)])
+
+    cam_dirs = None
+    if hoist_cond:
+        from diff3d_tpu.geometry import pinhole_rays_cam
+
+        H, W = record_imgs.shape[-3:-1]
+        K2 = jnp.concatenate([Kb, Kb])                 # [2B, 3, 3]
+        cam_dirs = pinhole_rays_cam(
+            K2[:, None].astype(jnp.float32), H, W)     # [2B, 1, H, W, 3]
 
     def step(state: SampleState, xs):
         logsnr, logsnr_next, idx, = xs
@@ -442,6 +461,8 @@ def sample_loop_scan(denoise_fn: DenoiseFn, state: SampleState, xs, *,
             jnp.concatenate([Tb, Tb]),
             jnp.concatenate([Kb, Kb]),
             logsnr_max=logsnr_max)
+        if cam_dirs is not None:
+            batch = dict(batch, cam_dirs=cam_dirs)     # scan constant
         eps = denoise_fn(batch, w_mask_2b)
         eps_cond, eps_uncond = eps[:B], eps[B:]
 

@@ -15,9 +15,44 @@ Conventions (matching visu3d's ``v3d.Camera(spec, world_from_cam).rays()``):
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
+
+
+def pinhole_rays_cam(K: jnp.ndarray, H: int, W: int,
+                     dtype: Optional[jnp.dtype] = None) -> jnp.ndarray:
+    """Camera-space ray directions ``K^-1 @ [u, v, 1]`` per pixel.
+
+    This half of :func:`pinhole_rays` depends only on the intrinsics —
+    per diffusion trajectory they are loop constants, so the sampler's
+    scan (``diffusion/core.py::sample_loop_scan``) hoists this stage out
+    of the per-step body (the K_inv·px contraction is the MC404-pinned
+    loop-invariant work).  Returns ``[..., H, W, 3]``.
+    """
+    if dtype is None:
+        dtype = K.dtype
+    u = jnp.arange(W, dtype=dtype) + 0.5
+    v = jnp.arange(H, dtype=dtype) + 0.5
+    uu, vv = jnp.meshgrid(u, v)            # each [H, W]
+    px = jnp.stack([uu, vv, jnp.ones_like(uu)], axis=-1)     # [H, W, 3]
+
+    K_inv = jnp.linalg.inv(K)                                # [..., 3, 3]
+    # dir_cam[..., h, w, i] = K_inv[..., i, j] @ px[h, w, j]
+    return jnp.einsum("...ij,hwj->...hwi", K_inv, px)
+
+
+def pinhole_rays_world(R: jnp.ndarray, t: jnp.ndarray,
+                       dir_cam: jnp.ndarray, normalize: bool = True
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pose-dependent half of :func:`pinhole_rays`: rotate camera-space
+    directions into the world frame and broadcast ray origins."""
+    dir_world = jnp.einsum("...ij,...hwj->...hwi", R, dir_cam)
+    if normalize:
+        dir_world = dir_world / jnp.linalg.norm(dir_world, axis=-1, keepdims=True)
+
+    pos = jnp.broadcast_to(t[..., None, None, :], dir_world.shape)
+    return pos, dir_world
 
 
 def pinhole_rays(R: jnp.ndarray, t: jnp.ndarray, K: jnp.ndarray,
@@ -33,19 +68,9 @@ def pinhole_rays(R: jnp.ndarray, t: jnp.ndarray, K: jnp.ndarray,
     Returns:
       ``(pos, dir)``, each ``[..., H, W, 3]`` — parity with the reference's
       ``rays.pos`` / ``rays.dir`` (``xunet.py:317-318``).
+
+    Composes :func:`pinhole_rays_cam` and :func:`pinhole_rays_world`
+    bit-identically to the original single-stage form.
     """
-    dtype = R.dtype
-    u = jnp.arange(W, dtype=dtype) + 0.5
-    v = jnp.arange(H, dtype=dtype) + 0.5
-    uu, vv = jnp.meshgrid(u, v)            # each [H, W]
-    px = jnp.stack([uu, vv, jnp.ones_like(uu)], axis=-1)     # [H, W, 3]
-
-    K_inv = jnp.linalg.inv(K)                                # [..., 3, 3]
-    # dir_cam[..., h, w, i] = K_inv[..., i, j] @ px[h, w, j]
-    dir_cam = jnp.einsum("...ij,hwj->...hwi", K_inv, px)
-    dir_world = jnp.einsum("...ij,...hwj->...hwi", R, dir_cam)
-    if normalize:
-        dir_world = dir_world / jnp.linalg.norm(dir_world, axis=-1, keepdims=True)
-
-    pos = jnp.broadcast_to(t[..., None, None, :], dir_world.shape)
-    return pos, dir_world
+    dir_cam = pinhole_rays_cam(K, H, W, dtype=R.dtype)
+    return pinhole_rays_world(R, t, dir_cam, normalize=normalize)

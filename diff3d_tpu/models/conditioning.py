@@ -15,7 +15,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
-from diff3d_tpu.geometry import pinhole_rays, posenc_ddpm, posenc_nerf
+from diff3d_tpu.geometry import (pinhole_rays_cam, pinhole_rays_world,
+                                 posenc_ddpm, posenc_nerf)
 from diff3d_tpu.geometry.posenc import posenc_nerf_channels
 
 # 93 (pos, degrees 0..15) + 51 (dir, degrees 0..8) = 144 channels,
@@ -71,11 +72,19 @@ class ConditioningProcessor(nn.Module):
             nn.silu(logsnr_emb))
 
         # [B, F, H, W, 3] each; K broadcast over the frame axis
-        # (reference unsqueezes K at xunet.py:312).
-        pos, dirs = pinhole_rays(batch["R"].astype(jnp.float32),
-                                 batch["t"].astype(jnp.float32),
-                                 batch["K"][:, None].astype(jnp.float32),
-                                 H, W)
+        # (reference unsqueezes K at xunet.py:312).  The intrinsics-only
+        # half (K_inv @ pixel grid) may arrive precomputed as
+        # batch['cam_dirs'] — the sampler's scan hoists it once per
+        # trajectory (diffusion/core.py) instead of recomputing it every
+        # denoise step; both branches are bit-identical by construction
+        # (pinhole_rays is the composition of the two stages).
+        cam_dirs = batch.get("cam_dirs")
+        if cam_dirs is None:
+            cam_dirs = pinhole_rays_cam(
+                batch["K"][:, None].astype(jnp.float32), H, W)
+        pos, dirs = pinhole_rays_world(batch["R"].astype(jnp.float32),
+                                       batch["t"].astype(jnp.float32),
+                                       cam_dirs)
         pose_emb = jnp.concatenate(
             [posenc_nerf(pos, 0, POS_DEG), posenc_nerf(dirs, 0, DIR_DEG)],
             axis=-1)                                             # [B, F, H, W, 144]

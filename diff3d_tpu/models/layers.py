@@ -13,12 +13,15 @@ and /sqrt(2) residual (:90-152), shared-weight frame self/cross attention
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from diff3d_tpu.ops import dispatch
+from diff3d_tpu.ops import pallas_film  # noqa: F401 - registers 'groupnorm'
 from diff3d_tpu.ops.attention import multi_head_attention
 
 
@@ -46,23 +49,71 @@ def _num_groups(C: int, preferred: int = 32) -> int:
     return g
 
 
+class _GroupNormParams(nn.Module):
+    """Parameter-only stand-in for ``nn.GroupNorm`` on the fused-kernel
+    path: same child name ("GroupNorm_0"), param names ("scale"/"bias"),
+    shapes, dtypes and inits, so a checkpoint trained with either kernel
+    backend restores bit-for-bit into the other."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        gamma = self.param("scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        beta = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        return gamma, beta
+
+
 class FrameGroupNorm(nn.Module):
     """Group normalization applied per frame (reference ``xunet.py:61-71``:
-    frames are folded into the batch axis before GN)."""
+    frames are folded into the batch axis before GN), with optional fused
+    FiLM/SiLU epilogues.
+
+    ``kernels`` routes through :mod:`diff3d_tpu.ops.dispatch`: 'xla' (the
+    default) runs the plain ``nn.GroupNorm`` composition — bit-identical
+    graphs to the pre-kernel-layer code; 'pallas'/'auto' may run the fused
+    GroupNorm->FiLM->SiLU Pallas kernel
+    (:mod:`diff3d_tpu.ops.pallas_film`), which keeps the whole chain in
+    VMEM.  ``scale``/``shift`` (both or neither, shaped like ``h``) append
+    the FiLM modulation ``y*(1+scale)+shift``; ``silu`` appends the
+    activation.  The parameter tree is identical on every path."""
 
     num_groups: int = 32
     dtype: jnp.dtype = jnp.float32
+    kernels: str = "xla"
+    silu: bool = False
 
     @nn.compact
-    def __call__(self, h: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, h: jnp.ndarray,
+                 scale: Optional[jnp.ndarray] = None,
+                 shift: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         B, F, H, W, C = h.shape
+        groups = _num_groups(C, self.num_groups)
+        flat = jax.ShapeDtypeStruct((B * F, H * W, C), h.dtype)
+        impl = dispatch.resolve("groupnorm", self.kernels, flat,
+                                num_groups=groups)
+        if impl.name == "pallas":
+            gamma, beta = _GroupNormParams(C, name="GroupNorm_0")()
+            kw = {}
+            if scale is not None:
+                kw = dict(scale=scale.reshape(B * F, H * W, C),
+                          shift=shift.reshape(B * F, H * W, C))
+            out = impl.fn(h.reshape(B * F, H * W, C), gamma, beta,
+                          num_groups=groups, silu=self.silu, **kw)
+            return out.reshape(B, F, H, W, C)
         # epsilon matches torch.nn.GroupNorm's 1e-5 (reference xunet.py:66);
         # Flax's default 1e-6 drifts ~1e-5/application across the ~40 GNs of
         # a converted checkpoint's forward.
-        out = nn.GroupNorm(num_groups=_num_groups(C, self.num_groups),
-                           epsilon=1e-5,
+        out = nn.GroupNorm(num_groups=groups, epsilon=1e-5,
                            dtype=self.dtype)(h.reshape(B * F, H, W, C))
-        return out.reshape(B, F, H, W, C)
+        out = out.reshape(B, F, H, W, C)
+        if scale is not None:
+            out = out * (1.0 + scale) + shift
+        if self.silu:
+            out = nn.silu(out)
+        return out
 
 
 class FiLM(nn.Module):
@@ -70,15 +121,23 @@ class FiLM(nn.Module):
     ``Dense(emb_ch -> 2*features)`` on SiLU(emb), split into scale/shift,
     ``h * (1 + scale) + shift``.  ``emb`` is ``[B, F, h, w, emb_ch]`` —
     channels-last, so no transposes are needed (the reference transposes
-    twice around its Linear)."""
+    twice around its Linear).
+
+    With ``h=None`` the module only *emits* ``(scale, shift)`` — the
+    fused-kernel path hands them to :class:`FrameGroupNorm`'s epilogue
+    instead of applying them here.  The parameter tree (``Dense_0``) is
+    unchanged either way."""
 
     features: int
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, h: jnp.ndarray, emb: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, h: Optional[jnp.ndarray], emb: jnp.ndarray
+                 ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
         emb = nn.Dense(2 * self.features, dtype=self.dtype)(nn.silu(emb))
         scale, shift = jnp.split(emb, 2, axis=-1)
+        if h is None:
+            return scale, shift
         return h * (1.0 + scale) + shift
 
 
@@ -94,18 +153,36 @@ class ResnetBlock(nn.Module):
     dropout: float = 0.0
     resample: Optional[str] = None   # None | 'up' | 'down'
     dtype: jnp.dtype = jnp.float32
+    kernels: str = "xla"
 
     @nn.compact
     def __call__(self, h_in: jnp.ndarray, emb: jnp.ndarray,
                  deterministic: bool = True) -> jnp.ndarray:
         B, F, H, W, C = h_in.shape
 
-        h = nn.silu(FrameGroupNorm(dtype=self.dtype)(h_in))
+        # One trace-time dispatch decision (on conv1's output shape)
+        # covers the whole block, so the FiLM emit/apply split always
+        # agrees with the second GroupNorm's backend.
+        flat2 = jax.ShapeDtypeStruct((B * F, H * W, self.features),
+                                     jnp.dtype(self.dtype))
+        use_fused = dispatch.resolve(
+            "groupnorm", self.kernels, flat2,
+            num_groups=_num_groups(self.features)).name == "pallas"
+
+        h = FrameGroupNorm(dtype=self.dtype, kernels=self.kernels,
+                           silu=True)(h_in)
         h = nn.Conv(self.features, (3, 3), dtype=self.dtype,
                     name="conv1")(h.reshape(B * F, H, W, C))
         h = h.reshape(B, F, H, W, self.features)
-        h = FrameGroupNorm(dtype=self.dtype)(h)
-        h = FiLM(self.features, dtype=self.dtype)(h, emb)
+        if use_fused:
+            scale, shift = FiLM(self.features, dtype=self.dtype)(None, emb)
+            scale = jnp.broadcast_to(scale, h.shape)
+            shift = jnp.broadcast_to(shift, h.shape)
+            h = FrameGroupNorm(dtype=self.dtype, kernels=self.kernels)(
+                h, scale=scale, shift=shift)
+        else:
+            h = FrameGroupNorm(dtype=self.dtype, kernels=self.kernels)(h)
+            h = FiLM(self.features, dtype=self.dtype)(h, emb)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         # Zero-init final conv (reference xunet.py:131) so the block starts
         # as (scaled) identity.
@@ -159,11 +236,12 @@ class AttnBlock(nn.Module):
     num_heads: int = 4
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
+    kernels: str = "xla"
 
     @nn.compact
     def __call__(self, h_in: jnp.ndarray) -> jnp.ndarray:
         B, F, H, W, C = h_in.shape
-        h = FrameGroupNorm(dtype=self.dtype)(h_in)
+        h = FrameGroupNorm(dtype=self.dtype, kernels=self.kernels)(h_in)
         tokens = h.reshape(B, F, H * W, C)
 
         q = tokens.reshape(B * F, H * W, C)
@@ -195,15 +273,19 @@ class XUNetBlock(nn.Module):
     dropout: float = 0.0
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
+    kernels: str = "xla"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, emb: jnp.ndarray,
                  deterministic: bool = True) -> jnp.ndarray:
         h = ResnetBlock(self.features, self.dropout, dtype=self.dtype,
+                        kernels=self.kernels,
                         name="resnetblock")(x, emb, deterministic)
         if self.use_attn:
             h = AttnBlock("self", self.num_heads, self.attn_impl,
-                          self.dtype, name="attnblock_self")(h)
+                          self.dtype, kernels=self.kernels,
+                          name="attnblock_self")(h)
             h = AttnBlock("cross", self.num_heads, self.attn_impl,
-                          self.dtype, name="attnblock_cross")(h)
+                          self.dtype, kernels=self.kernels,
+                          name="attnblock_cross")(h)
         return h

@@ -96,12 +96,13 @@ class XUNet(nn.Module):
                     features=dim_out[i_level], use_attn=use_attn,
                     num_heads=cfg.attn_heads, dropout=cfg.dropout,
                     attn_impl=cfg.attn_impl_at(i_level), dtype=dtype,
+                    kernels=cfg.kernels,
                     name=f"down_{i_level}_{i_block}")(h, emb, deterministic))
                 hs.append(h)
             if i_level != num_res - 1:
                 h = constrain(resnet_cls(
                     features=dim_out[i_level], dropout=cfg.dropout,
-                    resample="down", dtype=dtype,
+                    resample="down", dtype=dtype, kernels=cfg.kernels,
                     name=f"down_{i_level}_downsample")(h, emb, deterministic))
                 hs.append(h)
 
@@ -110,6 +111,7 @@ class XUNet(nn.Module):
             features=dim_out[-1], use_attn=num_res in cfg.attn_levels,
             num_heads=cfg.attn_heads, dropout=cfg.dropout,
             attn_impl=cfg.attn_impl_at(num_res - 1), dtype=dtype,
+            kernels=cfg.kernels,
             name="middle")(h, level_emb(num_res - 1), deterministic))
 
         # Up path (reference xunet.py:521-531): each block consumes
@@ -123,17 +125,19 @@ class XUNet(nn.Module):
                     features=dim_out[i_level], use_attn=use_attn,
                     num_heads=cfg.attn_heads, dropout=cfg.dropout,
                     attn_impl=cfg.attn_impl_at(i_level), dtype=dtype,
+                    kernels=cfg.kernels,
                     name=f"up_{i_level}_{i_block}")(h, emb, deterministic))
             if i_level != 0:
                 h = constrain(resnet_cls(
                     features=dim_out[i_level], dropout=cfg.dropout,
-                    resample="up", dtype=dtype,
+                    resample="up", dtype=dtype, kernels=cfg.kernels,
                     name=f"up_{i_level}_upsample")(h, emb, deterministic))
         assert not hs
 
         # Head: GN -> SiLU -> zero-init conv -> target frame's eps-hat
         # (reference xunet.py:472-474,535-536).
-        h = nn.silu(FrameGroupNorm(dtype=dtype, name="last_gn")(h))
+        h = FrameGroupNorm(dtype=dtype, kernels=cfg.kernels, silu=True,
+                           name="last_gn")(h)
         h = nn.Conv(3, (3, 3), dtype=dtype,
                     kernel_init=nn.initializers.zeros,
                     name="last_conv")(h.reshape(B * F, H, W, dim_out[0]))

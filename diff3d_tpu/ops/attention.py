@@ -2,7 +2,9 @@
 
 The reference runs ``torch.nn.MultiheadAttention`` over ``H*W`` tokens
 (``/root/reference/xunet.py:154-177``) — 4096 tokens at 64^2, 16384 at
-128^2.  Here the softmax(QK^T)V core is a swappable backend:
+128^2.  Here the softmax(QK^T)V core is a swappable backend registered
+with :mod:`diff3d_tpu.ops.dispatch` (shared with the fused GroupNorm
+epilogues):
 
   * ``'xla'``    — ``jax.nn.dot_product_attention``; XLA already emits a
     fused, flash-style kernel on TPU for moderate sequence lengths.
@@ -18,28 +20,51 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from diff3d_tpu.ops import dispatch
 
-def _resolve_auto(q: jnp.ndarray) -> str:
+
+def _xla_sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.dot_product_attention(q, k, v)
+
+
+def _pallas_sdpa(q: jnp.ndarray, k: jnp.ndarray,
+                 v: jnp.ndarray) -> jnp.ndarray:
+    from diff3d_tpu.ops.pallas_attention import flash_attention
+
+    return flash_attention(q, k, v)
+
+
+def _pallas_supports(q, k, v) -> bool:
+    from diff3d_tpu.ops.pallas_attention import supports
+
+    return supports(q, k, v)
+
+
+def _pallas_auto(q, *args) -> bool:
     """Measured policy (one v5e chip, X-UNet shapes — see tools/tune_train):
     the Pallas flash kernel zero-pads the head dim to the 128-lane MXU
     tile, so at D=32/64 it wastes 4x/2x of every QK^T and PV matmul and
     XLA's fused attention wins; only lane-filling heads (D > 64) with
     sequences long enough that the materialised [L, L] logits' HBM traffic
-    dominates are worth the flash kernel.
+    dominates are worth the flash kernel."""
+    D, L = q.shape[-1], q.shape[1]
+    return D > 64 and L >= 4096
+
+
+dispatch.register("sdpa", "xla", _xla_sdpa)
+dispatch.register("sdpa", "pallas", _pallas_sdpa,
+                  supports=_pallas_supports, auto=_pallas_auto)
+
+
+def _resolve_auto(q: jnp.ndarray) -> str:
+    """Backend an ``impl='auto'`` sdpa call resolves to for ``q``.
 
     'auto' resolves from the PROCESS-DEFAULT backend, not from where the
     computation is actually placed: a TPU-backed process tracing a
     CPU-mesh program must pass ``impl='xla'`` explicitly (tests/conftest
     and the dryrun pin the whole process to CPU instead, which also
     resolves correctly)."""
-    try:
-        platform = jax.default_backend()
-    except RuntimeError:  # no backend at trace time; be conservative
-        platform = "cpu"
-    if platform != "tpu":
-        return "xla"
-    D, L = q.shape[-1], q.shape[1]
-    return "pallas" if (D > 64 and L >= 4096) else "xla"
+    return dispatch.resolve("sdpa", "auto", q, q, q).name
 
 
 def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -52,21 +77,15 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     inside ``shard_map`` with that axis in scope.  This is how the X-UNet's
     attention layers scale past one device's tokens: set
     ``ModelConfig.attn_impl='ring:model'`` and run the step in a
-    ``shard_map`` whose specs shard the spatial axis.
+    ``shard_map`` whose specs shard the spatial axis.  Everything else
+    ('auto' | 'pallas' | 'xla') goes through the shared kernel registry.
     """
-    if impl == "auto":
-        impl = _resolve_auto(q)
     if ":" in impl:
         from diff3d_tpu.parallel import ring_sdpa, ulysses_sdpa
         kind, _, axis = impl.partition(":")
         fn = {"ring": ring_sdpa, "ulysses": ulysses_sdpa}[kind]
         return fn(q, k, v, axis_name=axis)
-    if impl == "pallas":
-        from diff3d_tpu.ops.pallas_attention import flash_attention, supports
-        if supports(q, k, v):
-            return flash_attention(q, k, v)
-        impl = "xla"
-    return jax.nn.dot_product_attention(q, k, v)
+    return dispatch.dispatch("sdpa", impl, q, k, v)
 
 
 def multi_head_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -122,7 +122,7 @@ def test_sampler_steps_sweep_structure():
     calls = []
 
     def fake_bench(config, n_views, object_batch, use_mesh,
-                   sampler_kind, steps):
+                   sampler_kind, steps, kernels=None):
         calls.append((config, sampler_kind, steps))
         # Per-view time shrinking sub-linearly with the schedule, like
         # real hardware (per-step overhead doesn't vanish).
@@ -176,11 +176,78 @@ def test_cascade_sweep_structure():
 
 
 def test_cascade_sweep_in_phase_sequence():
-    """The cascade sweep is a real phase: a round dying inside it must
-    report ``phase_reached == "cascade_sweep"`` in the partial record."""
+    """Cascade sweep and kernels A/B are real phases: a round dying
+    inside either must report it as ``phase_reached`` in the partial
+    record, in run order (cascade, then the A/B, then complete)."""
     seq = bench._PHASE_SEQUENCE
     assert "cascade_sweep" in seq
-    assert seq.index("cascade_sweep") == seq.index("complete") - 1
+    assert seq.index("kernels_ab") == seq.index("cascade_sweep") + 1
+    assert seq.index("kernels_ab") == seq.index("complete") - 1
+
+
+def test_kernels_ab_structure():
+    """The kernel A/B record: one variant per requested backend, timed
+    by the SAME train/sampler benches with only ``kernels`` varying,
+    speedups relative to variant 0, and per-variant error notes instead
+    of a voided record when one backend fails."""
+    calls = []
+
+    def fake_train(configs, n_steps, config, kernels=None):
+        calls.append(("train", config, kernels, tuple(configs)))
+        eps = {"xla": 100.0, "pallas": 125.0}[kernels]
+        return eps, configs[0][0], configs[0][1], {"step_ms_median": 9.0}
+
+    def fake_sampler(config, n_views, kernels=None):
+        calls.append(("sampler", config, kernels))
+        return {"xla": 2.0, "pallas": 1.6}[kernels], 6.0, 3
+
+    rec = bench._kernels_ab(["xla", "pallas"], configs=[(64, 1)],
+                            n_steps=5, train_fn=fake_train,
+                            sampler_fn=fake_sampler)
+    assert rec["metric"] == "kernels_ab_srn64"
+    assert rec["dimension"] == "kernels"
+    assert [c[2] for c in calls] == ["xla", "xla", "pallas", "pallas"]
+    assert all(c[3] == ((64, 1),) for c in calls if c[0] == "train")
+    xla, pallas = rec["variants"]
+    assert xla["kernels"] == "xla" and pallas["kernels"] == "pallas"
+    assert xla["train_examples_per_sec"] == 100.0
+    assert pallas["train_speedup_vs_xla"] == 1.25
+    assert pallas["sampler_speedup_vs_xla"] == 1.25
+    assert "train_speedup_vs_xla" not in xla    # base carries no ratio
+
+
+def test_kernels_ab_survives_one_variant_failing():
+    def fake_train(configs, n_steps, config, kernels=None):
+        if kernels == "pallas":
+            raise RuntimeError("RESOURCE_EXHAUSTED: vmem")
+        return 100.0, 64, 1, {"step_ms_median": 9.0}
+
+    def fake_sampler(config, n_views, kernels=None):
+        return 2.0, 6.0, 3
+
+    rec = bench._kernels_ab(["xla", "pallas"], train_fn=fake_train,
+                            sampler_fn=fake_sampler)
+    xla, pallas = rec["variants"]
+    assert xla["train_examples_per_sec"] == 100.0
+    assert "RESOURCE_EXHAUSTED" in pallas["train_error"]
+    assert "train_speedup_vs_xla" not in pallas
+    assert pallas["sampler_speedup_vs_xla"] == 1.0
+
+
+def test_main_rejects_unknown_kernel_backend(capsys):
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit):
+        bench.main(["--kernels", "cuda"])
+
+
+def test_partial_record_stamps_kernels():
+    bench._KERNELS["requested"] = ["xla", "pallas"]
+    try:
+        rec = bench._partial_record("test")
+        assert rec["kernels"] == ["xla", "pallas"]
+    finally:
+        bench._KERNELS["requested"] = ["xla"]
 
 
 def test_main_emits_parseable_json_when_backend_never_comes_up(

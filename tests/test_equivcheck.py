@@ -622,15 +622,22 @@ def test_eq604_agrees_with_memchecks_mc404_pin_tier1():
     """Cross-pillar agreement (the issue's satellite 4): equivcheck's
     static loop-invariant estimate for step_many must agree with the
     committed memcheck MC404 pin — two independent walks over the same
-    lowering.  (Both now report ~154 kFLOP/step; the historical
-    ~1.8 GFLOP figure was a shared parser artifact, fixed by parsing
-    generic-syntax anonymous regions.)"""
+    lowering.  The cam-dirs conditioning hoist collapsed both from
+    ~154 kFLOP/step to residual index bookkeeping; in that hoist-clean
+    regime the two walkers disagree on which <250-FLOP scraps count, so
+    agreement means BOTH sit under the noise floor rather than matching
+    to 25%.  (The historical ~1.8 GFLOP figure was a shared parser
+    artifact, fixed by parsing generic-syntax anonymous regions.)"""
+    _NOISE_FLOOR = 1000.0           # residual bookkeeping, not a dup
     sem = eqc.semantic_report_for("step_many")
     md = mc.default_manifest_dir(_REPO_ROOT)
     pin = mb.load_manifest(
         mb.manifest_path("step_many", md)).budgets.hoistable_flops_per_step
-    assert pin > 0 and sem.hoistable_flops_per_step > 0
-    assert sem.hoistable_flops_per_step == pytest.approx(pin, rel=0.25)
+    if pin >= _NOISE_FLOOR or sem.hoistable_flops_per_step >= _NOISE_FLOOR:
+        assert sem.hoistable_flops_per_step == pytest.approx(pin, rel=0.25)
+    else:
+        assert 0 <= pin < _NOISE_FLOOR
+        assert 0 <= sem.hoistable_flops_per_step < _NOISE_FLOOR
     # The static duplicate ceiling subsumes the per-iteration recompute.
     assert sem.duplicate_flops >= sem.hoistable_flops_per_step
 

@@ -11,8 +11,15 @@ per-level strided convs), every attention projection + sdpa core, and
 every FiLM dense — FiLM's conditioning input is [B, F, h, w, emb_ch]
 (full spatial extent, models/xunet.py:78-80), so its
 emb_ch -> 2*features dense is real per-pixel matmul work, ~17%% of the
-srn128 forward.  Omitted: GroupNorm/SiLU/residual elementwise (no
-matmul FLOPs) and the two logsnr MLP denses (spatial size 1).
+srn128 forward.  The fused-kernel sites (``ops/pallas_film.py``) are
+inventoried as their own classes — ``fused_gn_silu`` (ResnetBlock entry
+GroupNorm->SiLU + the head's last_gn) and ``fused_film`` (the
+GroupNorm->FiLM->SiLU epilogue) — with elementwise FLOPs (~10-12 per
+element), so the share the kernel layer covers is a number, not a
+hand-wave; their HBM-traffic share is far larger than their FLOP share,
+which is exactly why they are fused.  Still omitted: residual adds,
+plain attention GroupNorms, and the two logsnr MLP denses (spatial
+size 1).
 
 Why it exists (VERDICT r4 weak #6): the srn128 train step measures far
 below the chip's big-matmul ceiling.  ``tools/roofline.py`` measures
@@ -57,6 +64,10 @@ def inventory(cfg_model, microbatch: int):
 
     def resnet(lvl, cin, cout, tag):
         h = res_at(lvl)
+        # entry GroupNorm->SiLU, fused (pallas_film): ~10 elementwise
+        # flops/element (two-pass stats + normalize/affine + silu)
+        add("fused_gn_silu", lvl, 10.0 * BF * h * h * cin,
+            [BF, h, h, cin])
         add(f"conv3x3_{tag}", lvl, conv_flops(BF, h, h, cin, cout, 3),
             [BF, h, h, cin, cout, 3])
         add(f"conv3x3_{tag}", lvl, conv_flops(BF, h, h, cout, cout, 3),
@@ -66,6 +77,10 @@ def inventory(cfg_model, microbatch: int):
         add("film_dense", lvl,
             dense_flops(BF, h * h, cfg_model.emb_ch, 2 * cout),
             [BF, h * h, cfg_model.emb_ch, 2 * cout])
+        # GroupNorm->FiLM(scale/shift)->SiLU epilogue, fused: the GN's
+        # ~10 flops/element plus the modulate multiply-add
+        add("fused_film", lvl, 12.0 * BF * h * h * cout,
+            [BF, h, h, cout])
         if cin != cout:
             add(f"conv1x1_skip", lvl, conv_flops(BF, h, h, cin, cout, 1),
                 [BF, h, h, cin, cout, 1])
@@ -127,7 +142,9 @@ def inventory(cfg_model, microbatch: int):
             resnet(lvl, c, dims[lvl], "upsample")
     assert not hs
 
-    # head
+    # head: last_gn (GroupNorm->SiLU, fused) then the zero-init conv
+    add("fused_gn_silu", 0, 10.0 * BF * H * H * dims[0],
+        [BF, H, H, dims[0]])
     add("conv3x3_head", 0, conv_flops(BF, H, H, dims[0], 3, 3),
         [BF, H, H, dims[0], 3, 3])
     return ops
@@ -160,6 +177,8 @@ def main(argv=None):
             cls = "attn_proj"
         elif o["kind"] == "film_dense":
             cls = "film"
+        elif o["kind"] in ("fused_gn_silu", "fused_film"):
+            cls = o["kind"]         # the pallas_film kernel classes
         elif o["kind"] == "cond_conv":
             cls = "cond_conv"
         else:
